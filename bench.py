@@ -1,0 +1,64 @@
+"""Benchmark: ResNet-50 CIFAR-10 training steps/sec on one chip.
+
+Comparable to the reference's single-node flagship number — CIFAR-10
+ResNet-50 (6·8+2 layers), global batch 128, 13.94 steps/sec on 1× P100
+(reference README.md:28-30; BASELINE.md). Synthetic data (input pipeline
+excluded, same as the reference's steps/sec which measured the hot session
+loop). Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+BASELINE_STEPS_PER_SEC = 13.94  # reference README.md:28-30 (1x P100)
+
+
+def main():
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh, shard_batch
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    cfg = get_preset("cifar10_resnet50")  # resnet_size=50, bs=128, momentum
+    cfg.data.dataset = "synthetic"
+    n_dev = len(jax.devices())
+    cfg.mesh.data = n_dev
+    mesh = create_mesh(cfg.mesh)
+
+    trainer = Trainer(cfg, mesh=mesh)
+    trainer.init_state()
+    step_fn = trainer.jitted_train_step()
+
+    rng = np.random.RandomState(0)
+    batch = shard_batch({
+        "images": rng.randn(128, 32, 32, 3).astype(np.float32),
+        "labels": rng.randint(0, 10, (128,)).astype(np.int32),
+    }, mesh)
+
+    # warmup / compile
+    state = trainer.state
+    for _ in range(3):
+        state, m = step_fn(state, batch)
+    jax.block_until_ready(state.params)
+
+    iters = 100
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step_fn(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = iters / dt
+    print(json.dumps({
+        "metric": "cifar10_resnet50_bs128_train_steps_per_sec",
+        "value": round(steps_per_sec, 2),
+        "unit": "steps/sec",
+        "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""distributed_resnet_tensorflow_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+hanalice/Distributed-ResNet-Tensorflow (reference mounted at /root/reference):
+ResNet-v2 image classification on CIFAR-10/100 and ImageNet with synchronous
+data-parallel training. Where the reference used a grpc parameter-server
+(`tf.train.SyncReplicasOptimizer`, reference resnet_model.py:102-135) or
+Horovod MPI/NCCL allreduce (reference resnet_cifar_main_horovod.py), this
+framework uses one SPMD path: `jax.jit` over a `jax.sharding.Mesh` with
+sharding-induced XLA collectives riding ICI/DCN.
+
+Layout (mirrors SURVEY.md §7):
+  models/      pure-functional ResNet-v2 model zoo (flax.linen)
+  ops/         TPU ops: cross-replica batch norm, fused Pallas kernels,
+               ring attention / sequence parallelism
+  parallel/    mesh construction, sharding rules, collectives, multi-host init
+  data/        input pipelines (CIFAR binary, ImageNet TFRecord, synthetic)
+  train/       train loop, schedules, optimizers (incl. LARS), hooks
+  checkpoint/  orbax-backed async checkpointing with auto-resume
+  utils/       config system, metrics/logging
+  native/      C++ runtime components (threaded data loader, TFRecord reader)
+"""
+
+__version__ = "0.1.0"

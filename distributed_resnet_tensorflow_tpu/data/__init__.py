@@ -1,0 +1,25 @@
+from .synthetic import synthetic_iterator, learnable_synthetic_iterator  # noqa: F401
+from .cifar import cifar_iterator, load_cifar, standardize, augment_train  # noqa: F401
+
+
+def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
+                          num_shards: int = 1, batch_size=None):
+    """Input factory — the one definition replacing the 4 near-identical
+    ``input_fn`` copies in the reference mains (SURVEY.md §1 note)."""
+    d = cfg.data
+    bs = batch_size or (cfg.train.batch_size if mode == "train"
+                        else d.eval_batch_size)
+    if d.dataset == "synthetic":
+        return synthetic_iterator(bs, d.image_size, cfg.model.num_classes,
+                                  seed=cfg.train.seed)
+    if d.dataset in ("cifar10", "cifar100"):
+        return cifar_iterator(d.dataset, d.data_dir, bs, mode,
+                              seed=cfg.train.seed, shard_index=shard_index,
+                              num_shards=num_shards,
+                              prefetch=d.prefetch_batches)
+    if d.dataset == "imagenet":
+        from .imagenet import imagenet_iterator
+        return imagenet_iterator(d.data_dir, bs, mode, image_size=d.image_size,
+                                 seed=cfg.train.seed, shard_index=shard_index,
+                                 num_shards=num_shards)
+    raise ValueError(f"unknown dataset {d.dataset!r}")

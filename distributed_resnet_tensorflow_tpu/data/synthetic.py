@@ -1,0 +1,38 @@
+"""Synthetic data — deterministic fake batches for smoke tests and benchmarks.
+
+Successor of the reference's local smoke-run config (scripts/submit_mac_dist.sh
+with bs=10, 100 steps — SURVEY.md §4.1): exercises the full distributed step
+without touching disk.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, Tuple
+
+import numpy as np
+
+
+def synthetic_iterator(batch_size: int, image_size: int = 32,
+                       num_classes: int = 10, seed: int = 0,
+                       channels: int = 3) -> Iterator[Dict[str, np.ndarray]]:
+    """Yields random (but reproducible) image batches forever. Data is
+    generated once and cycled so the iterator costs nothing per step."""
+    rng = np.random.RandomState(seed)
+    images = rng.randn(batch_size, image_size, image_size, channels).astype(np.float32)
+    labels = rng.randint(0, num_classes, size=(batch_size,)).astype(np.int32)
+    batch = {"images": images, "labels": labels}
+    while True:
+        yield batch
+
+
+def learnable_synthetic_iterator(batch_size: int, image_size: int = 8,
+                                 num_classes: int = 4, seed: int = 0,
+                                 ) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic data with learnable structure (class-dependent mean) so tiny
+    convergence tests can assert the loss actually falls."""
+    rng = np.random.RandomState(seed)
+    protos = rng.randn(num_classes, image_size, image_size, 3).astype(np.float32)
+    while True:
+        labels = rng.randint(0, num_classes, size=(batch_size,)).astype(np.int32)
+        noise = 0.3 * rng.randn(batch_size, image_size, image_size, 3).astype(np.float32)
+        images = protos[labels] + noise
+        yield {"images": images, "labels": labels}

@@ -1,0 +1,8 @@
+from .resnet import (  # noqa: F401
+    CifarResNetV2,
+    ImageNetResNetV2,
+    IMAGENET_MODEL_PARAMS,
+    count_params,
+    create_model,
+)
+from .logistic import LogisticNet  # noqa: F401

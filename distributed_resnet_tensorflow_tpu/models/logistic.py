@@ -1,0 +1,25 @@
+"""Toy 1-hidden-layer MLP — debug stand-in for ResNet.
+
+Parity with reference logist_model.py (LRNet: flattened image → dense(hidden)
+→ ReLU → dense(classes), reference logist_model.py:14-58). Used to debug the
+distribution layer without conv cost, like the reference's commented swap at
+resnet_cifar_main.py:257.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LogisticNet(nn.Module):
+    num_classes: int = 10
+    hidden_units: int = 100
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        del train
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.Dense(self.hidden_units)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,1 @@
+from .batch_norm import GroupedBatchNorm  # noqa: F401

@@ -1,0 +1,17 @@
+from .mesh import (  # noqa: F401
+    AXES,
+    batch_axes,
+    batch_shard_count,
+    create_mesh,
+    data_sharding,
+    local_batch_size,
+    replicated,
+    resolve_axis_sizes,
+)
+from .sharding import (  # noqa: F401
+    make_global_batch,
+    param_sharding_rule,
+    shard_batch,
+    tree_param_shardings,
+)
+from .distributed import initialize, initialize_from_config, is_chief  # noqa: F401

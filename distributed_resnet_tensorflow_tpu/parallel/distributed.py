@@ -1,0 +1,84 @@
+"""Multi-host bootstrap.
+
+Replaces the reference's cluster bring-up — ``tf.train.ClusterSpec`` +
+``tf.train.Server`` grpc bootstrap (reference resnet_cifar_main.py:364-380)
+and Horovod's ``hvd.init()`` MPI bootstrap (reference
+resnet_cifar_main_horovod.py:342) — with ``jax.distributed.initialize`` over
+DCN: one process per TPU host, every process runs the same SPMD program.
+
+Topology can come from explicit config, from SLURM env vars (the reference's
+launchers derived ps/worker host lists from ``scontrol show hostnames``,
+reference scripts/run_dist_tf_daint.sh:30-76 — here SLURM integration is just
+reading env), or from TPU-pod metadata (jax autodetects when args are None).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def initialize_from_config(mesh_cfg) -> None:
+    """Initialize the distributed runtime if the config asks for >1 process."""
+    if mesh_cfg.num_processes <= 1 and not mesh_cfg.coordinator_address:
+        return
+    initialize(
+        coordinator_address=mesh_cfg.coordinator_address or None,
+        num_processes=mesh_cfg.num_processes or None,
+        process_id=mesh_cfg.process_id,
+    )
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Idempotent `jax.distributed.initialize` with SLURM fallback.
+
+    SLURM env contract (successor of the reference's TF_NUM_PS/TF_NUM_WORKERS
+    env contract, reference scripts/run_dist_tf_daint.sh:4-27):
+      SLURM_NTASKS → num_processes, SLURM_PROCID → process_id,
+      SLURM_STEP_NODELIST first node:8476 → coordinator.
+    """
+    if coordinator_address is None and "SLURM_NTASKS" in os.environ and \
+            int(os.environ["SLURM_NTASKS"]) > 1:
+        num_processes = int(os.environ["SLURM_NTASKS"])
+        process_id = int(os.environ["SLURM_PROCID"])
+        nodelist = os.environ.get("SLURM_STEP_NODELIST",
+                                  os.environ.get("SLURM_NODELIST", ""))
+        first = _first_slurm_node(nodelist)
+        coordinator_address = f"{first}:8476"
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        log.info("jax.distributed initialized: process %d/%d @ %s",
+                 jax.process_index(), jax.process_count(), coordinator_address)
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e).lower():
+            raise
+        log.info("jax.distributed already initialized")
+
+
+def _first_slurm_node(nodelist: str) -> str:
+    """Expand the first hostname from a SLURM nodelist like 'nid0[1234-1241]'.
+
+    Minimal re-implementation of what the reference got from
+    ``scontrol show hostnames`` (reference scripts/run_dist_tf_daint.sh:35).
+    """
+    if "[" not in nodelist:
+        return nodelist.split(",")[0].strip()
+    prefix, rest = nodelist.split("[", 1)
+    spec = rest.split("]", 1)[0]
+    first = spec.split(",")[0].split("-")[0]
+    return f"{prefix}{first}"
+
+
+def is_chief() -> bool:
+    """Process 0 — successor of the reference's ``is_chief = task_index == 0``
+    (reference resnet_cifar_main.py:323-335)."""
+    return jax.process_index() == 0

@@ -1,0 +1,119 @@
+"""Device mesh construction — the single SPMD replacement for BOTH reference
+communication backends.
+
+The reference shipped two data-parallel backends (SURVEY.md §2.8-2.9):
+  (a) grpc parameter-server + ``tf.train.SyncReplicasOptimizer``
+      (reference resnet_cifar_main.py:350-399, resnet_model.py:102-135) —
+      variables sharded round-robin onto ps tasks, gradient push/pull over
+      grpc, token-queue chief machinery; documented not to scale
+      (reference README.md:7-15).
+  (b) Horovod MPI/NCCL ring allreduce (reference resnet_cifar_main_horovod.py).
+
+Here both collapse into one path: a named ``jax.sharding.Mesh`` over which
+``jax.jit`` lays out arrays and XLA inserts the collectives (all-reduce /
+all-gather / reduce-scatter) on ICI/DCN. The parameter-server topology
+disappears; Horovod's rank-0 broadcast becomes replicated init by construction.
+
+Mesh axes (all present from day one so sequence/expert/pipeline workloads can
+be added without re-architecting — see SURVEY.md §5 "long-context" note):
+  data     — batch data parallelism (the reference's only axis)
+  fsdp     — ZeRO-like parameter/optimizer-state sharding
+  tensor   — tensor (op-level) parallelism
+  pipeline — pipeline stage parallelism
+  seq      — sequence/context parallelism (ring attention)
+  expert   — expert parallelism
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis order: fastest-varying (innermost, highest-bandwidth ICI)
+# axes last, so tensor/seq collectives ride the tightest links.
+AXES = ("pipeline", "data", "fsdp", "expert", "seq", "tensor")
+
+
+def resolve_axis_sizes(mesh_cfg, num_devices: Optional[int] = None) -> Tuple[int, ...]:
+    """Resolve a MeshConfig into concrete per-axis sizes.
+
+    Any axis set to -1 absorbs all remaining devices (at most one may be -1);
+    the product must equal the device count.
+    """
+    if num_devices is None:
+        num_devices = jax.device_count()
+    sizes = {
+        "pipeline": mesh_cfg.pipeline,
+        "data": mesh_cfg.data,
+        "fsdp": mesh_cfg.fsdp,
+        "expert": mesh_cfg.expert,
+        "seq": mesh_cfg.sequence,
+        "tensor": mesh_cfg.tensor,
+    }
+    # 0 and 1 both mean "collapsed axis"
+    sizes = {a: (1 if s == 0 else s) for a, s in sizes.items()}
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    if wild:
+        if num_devices % fixed != 0:
+            raise ValueError(
+                f"{num_devices} devices not divisible by fixed axes product {fixed}")
+        sizes[wild[0]] = num_devices // fixed
+    total = math.prod(sizes.values())
+    if total != num_devices:
+        raise ValueError(
+            f"mesh {sizes} covers {total} devices but {num_devices} are present")
+    return tuple(sizes[a] for a in AXES)
+
+
+def create_mesh(mesh_cfg=None, devices: Optional[Sequence[jax.Device]] = None,
+                axis_sizes: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Build the global mesh. ``jax.make_mesh`` / ``mesh_utils`` pick a
+    device permutation that keeps inner axes on the fastest ICI links."""
+    if devices is None:
+        devices = jax.devices()
+    if axis_sizes is None:
+        if mesh_cfg is None:
+            axis_sizes = tuple(
+                1 if a != "data" else len(devices) for a in AXES)
+        else:
+            axis_sizes = resolve_axis_sizes(mesh_cfg, len(devices))
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(
+            axis_sizes, devices=np.asarray(devices))
+    except Exception:
+        dev_array = np.asarray(devices).reshape(axis_sizes)
+    return Mesh(dev_array, AXES)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a batch: leading dim split over every batch-like axis
+    (data × fsdp), rest replicated."""
+    return NamedSharding(mesh, P(("data", "fsdp")))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Mesh axes the global batch is split over."""
+    return tuple(a for a in ("data", "fsdp") if mesh.shape[a] > 1) or ("data",)
+
+
+def batch_shard_count(mesh: Mesh) -> int:
+    return mesh.shape["data"] * mesh.shape["fsdp"]
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    n = batch_shard_count(mesh)
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{n} batch shards")
+    return global_batch // n

@@ -1,0 +1,4 @@
+from .loop import Trainer, make_train_step, make_eval_step, cross_entropy_loss  # noqa: F401
+from .state import TrainState, create_train_state, state_shardings  # noqa: F401
+from .schedules import create_schedule, piecewise, warmup_piecewise, warmup_cosine  # noqa: F401
+from .optimizers import create_optimizer, loss_weight_decay  # noqa: F401

@@ -1,0 +1,83 @@
+"""Optimizer factory.
+
+Parity with the reference's optimizer selection — plain SGD or momentum-0.9
+(reference resnet_model.py:96-99) — plus Adam (used by the toy model,
+reference logist_model.py:60) and LARS for the large-batch bs=32k config
+(BASELINE.json config 5; not in the reference, which collapsed at scale —
+reference README.md:51-52).
+
+Weight decay follows the reference semantics: L2 penalty over ALL trainable
+variables added to the loss (reference resnet_model.py:78-86), so decay is
+applied in the LOSS (see loop.py), not decoupled here — except for LARS,
+which takes decay inside the optimizer per the LARS paper formulation.
+
+There is no SyncReplicasOptimizer / DistributedOptimizer wrapper class: under
+``jit`` over a sharded batch, the gradient all-reduce is induced by sharding
+propagation (XLA emits it on ICI), so the base optimizer IS the distributed
+optimizer.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import optax
+
+
+def create_optimizer(opt_cfg, schedule: Callable) -> optax.GradientTransformation:
+    name = opt_cfg.name
+    chain = []
+    if opt_cfg.grad_clip_norm and opt_cfg.grad_clip_norm > 0:
+        chain.append(optax.clip_by_global_norm(opt_cfg.grad_clip_norm))
+
+    if name == "sgd":
+        chain.append(optax.sgd(schedule))
+    elif name == "momentum":
+        chain.append(optax.sgd(schedule, momentum=opt_cfg.momentum))
+    elif name == "adam":
+        chain.append(optax.adam(schedule))
+    elif name == "lars":
+        # optax.lars handles per-layer trust ratios; weight decay is part of
+        # the LARS update (masked away from BN/bias by weight_decay_mask).
+        chain.append(optax.lars(
+            schedule,
+            weight_decay=opt_cfg.weight_decay,
+            weight_decay_mask=_non_bn_mask,
+            trust_ratio_mask=_non_bn_mask,
+            trust_coefficient=opt_cfg.lars_trust_coefficient,
+            eps=opt_cfg.lars_eps,
+            momentum=opt_cfg.momentum))
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    return optax.chain(*chain) if len(chain) > 1 else chain[0]
+
+
+def _non_bn_mask(params):
+    """True for params that should get weight decay / trust-ratio scaling:
+    exclude BatchNorm scale/bias and all 1-D params (biases)."""
+    import jax
+
+    def keep(path, leaf):
+        names = [str(p) for p in path]
+        if any("BatchNorm" in n for n in names):
+            return False
+        return leaf.ndim > 1
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [keep(path, leaf) for path, leaf in flat])
+
+
+def loss_weight_decay(params, rate: float):
+    """L2 decay term added to the loss over all trainable variables —
+    the reference's formulation (resnet_model.py:78-86). Returns 0.5*rate*Σ‖w‖²
+    over conv/dense kernels (ndim>1), matching what TF's losses summed."""
+    import jax
+    import jax.numpy as jnp
+
+    if rate == 0.0:
+        return 0.0
+    leaves = [leaf for path, leaf in
+              jax.tree_util.tree_flatten_with_path(params)[0]
+              if leaf.ndim > 1]
+    return 0.5 * rate * sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                            for l in leaves)

@@ -1,0 +1,83 @@
+"""Learning-rate schedules as pure ``step -> lr`` functions.
+
+Replaces the reference's ``_LearningRateSetterHook`` feed-dict mechanism
+(reference resnet_cifar_main.py:287-307, resnet_imagenet_main.py:223-247) —
+a session hook that fed a new lr every step — with pure functions evaluated
+inside the jitted train step (XLA-friendly: `jnp.where` chains, no Python
+branching on traced values).
+
+Reference recipes reproduced exactly:
+  * CIFAR: 0.1 / 0.01 / 0.001 / 0.0001 at steps 40k / 60k / 80k
+    (reference resnet_cifar_main.py:298-307).
+  * ImageNet (Intel-Caffe 8-node recipe, reference README.md:42): linear
+    warmup 0.1→0.4 over 6240 steps, then piecewise ×0.1 at 37440 / 74880 /
+    99840 (reference resnet_imagenet_main.py:236-247).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import optax
+
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def piecewise(boundaries: Sequence[int], values: Sequence[float]) -> Schedule:
+    """Step-piecewise constant. len(values) == len(boundaries) + 1."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError(f"need {len(boundaries)+1} values, got {len(values)}")
+    b = jnp.asarray(boundaries, dtype=jnp.int32)
+    v = jnp.asarray(values, dtype=jnp.float32)
+
+    def fn(step):
+        idx = jnp.sum(jnp.asarray(step, jnp.int32) >= b)
+        return v[idx]
+
+    return fn
+
+
+def warmup_piecewise(warmup_steps: int, warmup_start: float, peak: float,
+                     boundaries: Sequence[int], values: Sequence[float]) -> Schedule:
+    """Linear warmup (start→peak over warmup_steps) then piecewise — the
+    reference's ImageNet recipe (resnet_imagenet_main.py:236-247)."""
+    pw = piecewise(boundaries, values)
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / jnp.maximum(warmup_steps, 1), 0.0, 1.0)
+        warm = warmup_start + frac * (peak - warmup_start)
+        return jnp.where(step < warmup_steps, warm, pw(step))
+
+    return fn
+
+
+def warmup_cosine(warmup_steps: int, peak: float, total_steps: int,
+                  end_value: float = 0.0) -> Schedule:
+    """Warmup + cosine decay — the standard large-batch (LARS) schedule;
+    not in the reference, required for the bs=32k config (BASELINE.json)."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak, warmup_steps=max(warmup_steps, 1),
+        decay_steps=total_steps, end_value=end_value)
+
+
+def constant(value: float) -> Schedule:
+    return lambda step: jnp.asarray(value, jnp.float32)
+
+
+def create_schedule(opt_cfg) -> Schedule:
+    """Factory from OptimizerConfig."""
+    name = opt_cfg.schedule
+    if name == "piecewise":
+        return piecewise(opt_cfg.boundaries, opt_cfg.values)
+    if name == "warmup_piecewise":
+        return warmup_piecewise(opt_cfg.warmup_steps, opt_cfg.warmup_start,
+                                opt_cfg.values[0], opt_cfg.boundaries,
+                                opt_cfg.values)
+    if name == "cosine":
+        return warmup_cosine(opt_cfg.warmup_steps, opt_cfg.learning_rate,
+                             opt_cfg.total_steps)
+    if name == "constant":
+        return constant(opt_cfg.learning_rate)
+    raise ValueError(f"unknown schedule {name!r}")

@@ -1,0 +1,13 @@
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    DataConfig,
+    EvalConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    PRESETS,
+    TrainConfig,
+    get_preset,
+    parse_args,
+)

@@ -1,0 +1,310 @@
+"""Typed configuration system.
+
+Replaces the reference's per-entry-point ``tf.app.flags`` blocks (reference
+resnet_cifar_main.py:30-88, resnet_imagenet_main.py:31-83,
+resnet_cifar_eval.py:27-55 — ~25 flags redefined in every file, see SURVEY.md
+§2.16) with a single set of dataclasses defined once, plus dotted-path CLI
+overrides (``--train.batch_size=256``) and named presets reproducing the
+reference's published configurations.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+
+@dataclass
+class ModelConfig:
+    """Model selection. Mirrors reference HParams (resnet_model.py:36-39) plus
+    the size/width axes the reference hard-coded (resnet_model.py:71-74 pins
+    resnet_size=50 for both datasets)."""
+
+    name: str = "resnet"              # resnet | logistic
+    resnet_size: int = 50             # cifar: 6n+2 ∈ {20,32,44,50,56,110,...}; imagenet: 18/34/50/101/152/200
+    width_multiplier: int = 1         # Wide-ResNet (e.g. 28-10 → resnet_size=28, width=10)
+    num_classes: int = 10
+    # bfloat16 compute with fp32 params is the TPU-native choice; the reference
+    # was fp32-only (TF1.3 era).
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    # Cross-replica batchnorm (lax.pmean of batch moments over the data axis)
+    # fixes the per-replica-BN accuracy gap the reference suffered
+    # (reference README.md:38,54). Both modes supported for comparison.
+    cross_replica_bn: bool = True
+    bn_momentum: float = 0.997        # reference resnet_model_official.py:37
+    bn_epsilon: float = 1e-5          # reference resnet_model_official.py:38
+    # toy MLP (reference logist_model.py:10-11)
+    hidden_units: int = 100
+    input_size: int = 32 * 32 * 3
+
+
+@dataclass
+class DataConfig:
+    """Input pipeline. Covers reference cifar_input.py + the tf.data paths
+    (SURVEY.md §2.4-2.7)."""
+
+    dataset: str = "cifar10"          # cifar10 | cifar100 | imagenet | synthetic
+    data_dir: str = ""
+    image_size: int = 32              # 32 cifar, 224 imagenet (reference resnet_imagenet_main.py image_size flag)
+    shuffle_buffer: int = 50000       # full-epoch CIFAR shuffle (reference resnet_cifar_main.py:221)
+    prefetch_batches: int = 2         # reference prefetches 2*bs samples (resnet_cifar_main.py:232)
+    num_parallel_calls: int = 8
+    use_native_loader: bool = False   # C++ threaded loader (native/)
+    # eval pipeline
+    eval_batch_size: int = 100        # reference resnet_cifar_eval.py batch of 100
+
+
+@dataclass
+class OptimizerConfig:
+    """Optimizer + LR schedule. Reference: SGD / momentum-0.9
+    (resnet_model.py:96-99), step-piecewise LR (resnet_cifar_main.py:298-307),
+    warmup+piecewise for ImageNet (resnet_imagenet_main.py:236-247).
+    Adds LARS for large-batch (bs=32k) scaling."""
+
+    name: str = "momentum"            # sgd | momentum | adam | lars
+    momentum: float = 0.9
+    learning_rate: float = 0.1
+    weight_decay: float = 2e-4        # cifar train value (reference resnet_cifar_main.py:99); imagenet: 1e-4
+    # schedule: piecewise | warmup_piecewise | cosine | constant
+    schedule: str = "piecewise"
+    boundaries: Tuple[int, ...] = (40000, 60000, 80000)      # reference resnet_cifar_main.py:298-307
+    values: Tuple[float, ...] = (0.1, 0.01, 0.001, 0.0001)
+    warmup_steps: int = 0             # imagenet recipe: 6240 (reference resnet_imagenet_main.py:236-247)
+    warmup_start: float = 0.1
+    total_steps: int = 100000
+    label_smoothing: float = 0.0
+    grad_clip_norm: float = 0.0       # 0 = off
+    # LARS
+    lars_trust_coefficient: float = 0.001
+    lars_eps: float = 0.0
+
+
+@dataclass
+class MeshConfig:
+    """Device mesh. Replaces the reference's two comm backends (grpc PS +
+    Horovod ring, SURVEY.md §2.8-2.9) with named mesh axes. Values of 0/1
+    collapse the axis. -1 on exactly one axis means "all remaining devices"."""
+
+    data: int = -1                    # data parallel (the reference's only axis)
+    fsdp: int = 1                     # ZeRO-like param/optimizer sharding
+    tensor: int = 1                   # tensor parallelism
+    pipeline: int = 1                 # pipeline parallelism
+    sequence: int = 1                 # sequence/context parallelism (ring attention)
+    expert: int = 1                   # expert parallelism
+    # multi-host
+    coordinator_address: str = ""     # empty = single process
+    num_processes: int = 1
+    process_id: int = 0
+
+
+@dataclass
+class TrainConfig:
+    batch_size: int = 128             # GLOBAL batch (reference global bs semantics, README.md:41-42)
+    train_steps: int = 100000
+    eval_every_steps: int = 0         # 0 = no in-loop eval
+    log_every_steps: int = 20         # reference LoggingTensorHook cadence (resnet_cifar_main.py:280-285)
+    summary_every_steps: int = 100    # reference SummarySaverHook (resnet_cifar_main.py:274-278)
+    seed: int = 0
+    # gradient accumulation (for large global batches on few chips)
+    grad_accum_steps: int = 1
+    remat: bool = False               # jax.checkpoint the block stack
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: chief-only time-based ckpt every 60s via
+    MonitoredTrainingSession (resnet_cifar_main.py:327-329), auto-resume."""
+
+    directory: str = ""
+    save_every_steps: int = 1000
+    save_every_secs: float = 60.0     # time-based like the reference; 0 = off
+    max_to_keep: int = 5
+    async_save: bool = True
+    resume: bool = True               # auto-resume from latest
+
+
+@dataclass
+class EvalConfig:
+    """Standalone polling evaluator (reference resnet_cifar_eval.py:85-141)."""
+
+    eval_batch_count: int = 50        # reference eval_batch_count flag (=50)
+    eval_once: bool = False
+    poll_interval_secs: float = 60.0  # reference sleeps 60s between polls
+    eval_dir: str = ""
+
+
+@dataclass
+class ExperimentConfig:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    eval: EvalConfig = field(default_factory=EvalConfig)
+    mode: str = "train"               # train | eval | train_and_eval
+    log_root: str = "/tmp/drt_tpu"    # reference log_root flag
+
+    # ---- serialization ----
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExperimentConfig":
+        cfg = cls()
+        _apply_dict(cfg, d)
+        return cfg
+
+    def override(self, dotted: str, value: Any) -> None:
+        """Apply one dotted-path override, e.g. ("train.batch_size", 256)."""
+        obj = self
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        leaf = parts[-1]
+        if not hasattr(obj, leaf):
+            raise KeyError(f"unknown config key: {dotted}")
+        cur = getattr(obj, leaf)
+        setattr(obj, leaf, _coerce(value, cur))
+
+
+def _coerce(value: Any, template: Any) -> Any:
+    if isinstance(value, str):
+        if isinstance(template, bool):
+            return value.lower() in ("1", "true", "yes", "on")
+        if isinstance(template, int) and not isinstance(template, bool):
+            return int(value)
+        if isinstance(template, float):
+            return float(value)
+        if isinstance(template, tuple):
+            if not value.strip():
+                return ()
+            elems = [v.strip() for v in value.split(",") if v.strip()]
+            et = float if (template and isinstance(template[0], float)) else int
+            return tuple(et(e) for e in elems)
+    if isinstance(template, tuple) and isinstance(value, list):
+        return tuple(value)
+    return value
+
+
+def _apply_dict(obj: Any, d: dict) -> None:
+    for k, v in d.items():
+        if not hasattr(obj, k):
+            raise KeyError(f"unknown config key: {k}")
+        cur = getattr(obj, k)
+        if dataclasses.is_dataclass(cur) and isinstance(v, dict):
+            _apply_dict(cur, v)
+        else:
+            setattr(obj, k, _coerce(v, cur))
+
+
+# ---------------------------------------------------------------------------
+# Presets: named configs reproducing the reference's published runs
+# (BASELINE.md table; reference README.md:22-52).
+# ---------------------------------------------------------------------------
+
+def _cifar10_resnet50() -> ExperimentConfig:
+    """Reference flagship: CIFAR-10 ResNet-50, gbs=128, piecewise LR
+    (README.md:28-30 — 93.6% top-1 @ ~80k steps)."""
+    cfg = ExperimentConfig()
+    cfg.model = ModelConfig(resnet_size=50, num_classes=10)
+    cfg.data = DataConfig(dataset="cifar10", image_size=32)
+    cfg.optimizer = OptimizerConfig(
+        name="momentum", learning_rate=0.1, weight_decay=2e-4,
+        schedule="piecewise", boundaries=(40000, 60000, 80000),
+        values=(0.1, 0.01, 0.001, 0.0001), total_steps=100000)
+    cfg.train = TrainConfig(batch_size=128, train_steps=100000)
+    return cfg
+
+
+def _cifar100_wrn2810() -> ExperimentConfig:
+    """Wide-ResNet-28-10 on CIFAR-100 (BASELINE.json config 4; exercises the
+    width/depth generalization of reference resnet_model_official.py:217-278)."""
+    cfg = _cifar10_resnet50()
+    cfg.model = ModelConfig(resnet_size=28, width_multiplier=10, num_classes=100)
+    cfg.data = DataConfig(dataset="cifar100", image_size=32)
+    cfg.optimizer.weight_decay = 5e-4
+    return cfg
+
+
+def _imagenet_resnet50() -> ExperimentConfig:
+    """ImageNet ResNet-50 gbs=1024, Intel-Caffe 8-node recipe the reference
+    used (resnet_imagenet_main.py:236-247; README.md:42)."""
+    cfg = ExperimentConfig()
+    cfg.model = ModelConfig(resnet_size=50, num_classes=1001)
+    cfg.data = DataConfig(dataset="imagenet", image_size=224)
+    cfg.optimizer = OptimizerConfig(
+        name="momentum", learning_rate=0.4, weight_decay=1e-4,
+        schedule="warmup_piecewise", warmup_steps=6240, warmup_start=0.1,
+        boundaries=(37440, 74880, 99840),
+        values=(0.4, 0.04, 0.004, 0.0004), total_steps=112640)
+    cfg.train = TrainConfig(batch_size=1024, train_steps=112640,
+                            log_every_steps=40)
+    cfg.checkpoint.save_every_secs = 600.0  # imagenet default cadence (SURVEY §2.14)
+    return cfg
+
+
+def _imagenet_resnet50_lars32k() -> ExperimentConfig:
+    """Large-batch: bs=32k + LARS (BASELINE.json config 5)."""
+    cfg = _imagenet_resnet50()
+    cfg.optimizer = OptimizerConfig(
+        name="lars", learning_rate=29.0, weight_decay=1e-4,
+        schedule="cosine",
+        warmup_steps=800, total_steps=3600, label_smoothing=0.1)
+    cfg.train = TrainConfig(batch_size=32768, train_steps=3600,
+                            log_every_steps=10)
+    return cfg
+
+
+def _cifar10_smoke() -> ExperimentConfig:
+    """Local smoke test analog of reference scripts/submit_mac_dist.sh
+    (1ps+2wk, bs=10, 100 steps on CPU — SURVEY.md §4.1)."""
+    cfg = _cifar10_resnet50()
+    cfg.model.resnet_size = 20
+    cfg.data.dataset = "synthetic"
+    cfg.train = TrainConfig(batch_size=10, train_steps=100, log_every_steps=10)
+    cfg.optimizer.total_steps = 100
+    cfg.checkpoint.save_every_secs = 0.0
+    return cfg
+
+
+PRESETS = {
+    "cifar10_resnet50": _cifar10_resnet50,
+    "cifar100_wrn28_10": _cifar100_wrn2810,
+    "imagenet_resnet50": _imagenet_resnet50,
+    "imagenet_resnet50_lars32k": _imagenet_resnet50_lars32k,
+    "smoke": _cifar10_smoke,
+}
+
+
+def get_preset(name: str) -> ExperimentConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]()
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> ExperimentConfig:
+    """CLI: ``--preset cifar10_resnet50 --set train.batch_size=256 ...``"""
+    p = argparse.ArgumentParser(description="distributed_resnet_tensorflow_tpu trainer")
+    p.add_argument("--preset", default="cifar10_resnet50", choices=sorted(PRESETS))
+    p.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                   help="dotted config override, e.g. --set train.batch_size=256")
+    p.add_argument("--config_json", default="", help="path to a JSON config to load")
+    ns = p.parse_args(argv)
+    if ns.config_json:
+        with open(ns.config_json) as f:
+            cfg = ExperimentConfig.from_dict(json.load(f))
+    else:
+        cfg = get_preset(ns.preset)
+    for ov in ns.set:
+        if "=" not in ov:
+            raise ValueError(f"--set expects KEY=VALUE, got {ov!r}")
+        k, v = ov.split("=", 1)
+        cfg.override(k, v)
+    return cfg

@@ -1,0 +1,53 @@
+"""Test harness: force an 8-device fake CPU mesh.
+
+This is the successor of the reference's only integration test — the local
+1ps+2wk CPU smoke cluster (reference scripts/submit_mac_dist.sh:9-39,
+run_dist_tf_local.sh:14-22) — done the JAX way: 8 virtual host devices via
+``xla_force_host_platform_device_count`` so every sharding/collective path
+runs without TPU hardware (SURVEY.md §4 implication).
+
+NOTE: this environment's sitecustomize registers an 'axon' TPU backend and
+forces ``jax_platforms=axon,cpu`` via jax.config (which overrides the
+JAX_PLATFORMS env var), so we must flip it back through jax.config, before
+any backend is initialized.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8 and devs[0].platform == "cpu"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    """Pure data-parallel 8-device mesh (the reference's topology)."""
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+    return create_mesh(MeshConfig(data=8))
+
+
+@pytest.fixture(scope="session")
+def mesh_dp_fsdp(devices):
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+    return create_mesh(MeshConfig(data=4, fsdp=2))
+
+
+@pytest.fixture()
+def rng():
+    return np.random.RandomState(0)

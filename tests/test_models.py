@@ -1,0 +1,123 @@
+"""Model zoo tests — shapes, param structure, variant table, v2 semantics
+(covers reference resnet_model_official.py behaviors, SURVEY.md §2.1)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_resnet_tensorflow_tpu.models import (
+    CifarResNetV2, ImageNetResNetV2, IMAGENET_MODEL_PARAMS, LogisticNet,
+    count_params, create_model)
+from distributed_resnet_tensorflow_tpu.utils.config import ModelConfig
+
+
+def _init_and_apply(model, shape, train=False):
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros(shape, jnp.float32)
+    variables = model.init(rng, x, train=False)
+    if train:
+        out, _ = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    else:
+        out = model.apply(variables, x, train=False)
+    return variables, out
+
+
+def test_cifar_resnet_shapes():
+    model = CifarResNetV2(resnet_size=20, num_classes=10, dtype=jnp.float32)
+    variables, logits = _init_and_apply(model, (4, 32, 32, 3))
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_cifar_resnet_size_validation():
+    """6n+2 constraint (reference resnet_model_official.py:217-231)."""
+    model = CifarResNetV2(resnet_size=21)
+    with pytest.raises(ValueError):
+        _init_and_apply(model, (1, 32, 32, 3))
+
+
+def test_cifar_resnet20_param_count():
+    """ResNet-20 v2 CIFAR ≈ 0.27M params (well-known figure)."""
+    model = CifarResNetV2(resnet_size=20, num_classes=10, dtype=jnp.float32)
+    variables, _ = _init_and_apply(model, (1, 32, 32, 3))
+    n = count_params(variables["params"])
+    assert 0.25e6 < n < 0.30e6, n
+
+
+def test_wide_resnet_28_10_param_count():
+    """WRN-28-10 ≈ 36.5M params — exercises the width generalization
+    (BASELINE.json config 4)."""
+    model = CifarResNetV2(resnet_size=28, width_multiplier=10,
+                          num_classes=100, dtype=jnp.float32)
+    variables, logits = _init_and_apply(model, (2, 32, 32, 3))
+    n = count_params(variables["params"])
+    assert 35e6 < n < 38e6, n
+    assert logits.shape == (2, 100)
+
+
+@pytest.mark.parametrize("size", [18, 50])
+def test_imagenet_resnet_shapes(size):
+    model = ImageNetResNetV2(resnet_size=size, num_classes=1001,
+                             dtype=jnp.float32)
+    variables, logits = _init_and_apply(model, (2, 64, 64, 3))
+    assert logits.shape == (2, 1001)
+
+
+def test_imagenet_resnet50_param_count():
+    """ResNet-50 ≈ 25.6M params (1001 classes)."""
+    model = ImageNetResNetV2(resnet_size=50, num_classes=1001,
+                             dtype=jnp.float32)
+    variables, _ = _init_and_apply(model, (1, 224, 224, 3))
+    n = count_params(variables["params"])
+    assert 25e6 < n < 26.5e6, n
+
+
+def test_imagenet_size_table():
+    """Size table parity (reference resnet_model_official.py:352-359)."""
+    assert set(IMAGENET_MODEL_PARAMS) == {18, 34, 50, 101, 152, 200}
+    assert IMAGENET_MODEL_PARAMS[50] == ("bottleneck", (3, 4, 6, 3))
+    assert IMAGENET_MODEL_PARAMS[18] == ("building", (2, 2, 2, 2))
+    model = ImageNetResNetV2(resnet_size=77)
+    with pytest.raises(ValueError):
+        _init_and_apply(model, (1, 64, 64, 3))
+
+
+def test_batch_stats_update_in_train_mode():
+    """BN moving stats must change in train mode and be used in eval —
+    successor of the reference's UPDATE_OPS control-dep wiring
+    (reference resnet_model.py:118-121)."""
+    model = CifarResNetV2(resnet_size=20, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+    variables = model.init(rng, x, train=False)
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    old = jax.tree_util.tree_leaves(variables["batch_stats"])
+    new = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(o, n) for o, n in zip(old, new))
+
+
+def test_bfloat16_compute_fp32_params():
+    model = CifarResNetV2(resnet_size=20, dtype=jnp.bfloat16)
+    variables, logits = _init_and_apply(model, (2, 32, 32, 3))
+    # params stay fp32 (master weights), head output fp32
+    kernels = jax.tree_util.tree_leaves(variables["params"])
+    assert all(k.dtype == jnp.float32 for k in kernels)
+    assert logits.dtype == jnp.float32
+
+
+def test_logistic_net():
+    """Toy MLP parity (reference logist_model.py)."""
+    model = LogisticNet(num_classes=10, hidden_units=100)
+    variables, logits = _init_and_apply(model, (4, 32, 32, 3))
+    assert logits.shape == (4, 10)
+
+
+def test_create_model_factory():
+    cfg = ModelConfig(resnet_size=20, num_classes=10, compute_dtype="float32")
+    m = create_model(cfg, "cifar10")
+    assert isinstance(m, CifarResNetV2)
+    cfg2 = ModelConfig(resnet_size=50, num_classes=1001, compute_dtype="float32")
+    m2 = create_model(cfg2, "imagenet")
+    assert isinstance(m2, ImageNetResNetV2)
+    cfg3 = ModelConfig(name="logistic")
+    assert isinstance(create_model(cfg3, "cifar10"), LogisticNet)

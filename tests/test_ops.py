@@ -1,0 +1,78 @@
+"""Tests for ops/ — GroupedBatchNorm semantics (cross-replica vs the
+reference's per-replica BN, SURVEY.md §7 'hard parts')."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_resnet_tensorflow_tpu.ops import GroupedBatchNorm
+
+
+def _apply(model, x, train=True):
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    if train:
+        y, mut = model.apply(variables, x, train=True, mutable=["batch_stats"])
+        return y, variables, mut["batch_stats"]
+    return model.apply(variables, x, train=False), variables, None
+
+
+def test_global_bn_normalizes():
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 4, 4, 8) * 3 + 5,
+                    jnp.float32)
+    model = GroupedBatchNorm(dtype=jnp.float32, groups=1)
+    y, _, _ = _apply(model, x)
+    assert np.allclose(np.asarray(y).mean((0, 1, 2)), 0, atol=1e-4)
+    assert np.allclose(np.asarray(y).std((0, 1, 2)), 1, atol=1e-2)
+
+
+def test_grouped_bn_equals_per_shard_bn():
+    """groups=G must reproduce running BN independently on each shard —
+    the reference's per-replica semantics (reference README.md:38,54)."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 4, 4, 3).astype(np.float32))
+    grouped = GroupedBatchNorm(dtype=jnp.float32, groups=2)
+    y, _, _ = _apply(grouped, x)
+
+    single = GroupedBatchNorm(dtype=jnp.float32, groups=1)
+    y0, _, _ = _apply(single, x[:4])
+    y1, _, _ = _apply(single, x[4:])
+    np.testing.assert_allclose(np.asarray(y),
+                               np.concatenate([np.asarray(y0), np.asarray(y1)]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_bn_running_stats_are_global():
+    """Running stats must aggregate over ALL groups (law of total variance)
+    so the evaluator sees one consistent moment set."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(32, 2, 2, 4).astype(np.float32) * 2 + 1)
+    g = GroupedBatchNorm(dtype=jnp.float32, groups=4, momentum=0.0)
+    _, _, stats = _apply(g, x)
+    want_mean = np.asarray(x).mean((0, 1, 2))
+    want_var = np.asarray(x).var((0, 1, 2))
+    np.testing.assert_allclose(np.asarray(stats["mean"]), want_mean, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats["var"]), want_var, atol=1e-4)
+
+
+def test_eval_uses_running_stats():
+    x = jnp.ones((4, 2, 2, 3), jnp.float32)
+    model = GroupedBatchNorm(dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    y = model.apply(variables, x, train=False)
+    # fresh stats: mean 0 var 1 → y ≈ x
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-3)
+
+
+def test_indivisible_groups_raise():
+    import pytest
+    x = jnp.ones((6, 2, 2, 3), jnp.float32)
+    model = GroupedBatchNorm(dtype=jnp.float32, groups=4)
+    with pytest.raises(ValueError):
+        model.init(jax.random.PRNGKey(0), x, train=True)
+
+
+def test_mesh_axis_zero_collapses():
+    """MeshConfig axis 0 == collapsed (docstring contract)."""
+    from distributed_resnet_tensorflow_tpu.parallel import resolve_axis_sizes
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+    sizes = resolve_axis_sizes(MeshConfig(data=-1, tensor=0), 8)
+    assert sizes == (1, 8, 1, 1, 1, 1)

@@ -1,0 +1,55 @@
+"""LR schedule tests — exact replay of the reference's recipes
+(reference resnet_cifar_main.py:298-307, resnet_imagenet_main.py:236-247)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_resnet_tensorflow_tpu.train.schedules import (
+    create_schedule, piecewise, warmup_piecewise)
+from distributed_resnet_tensorflow_tpu.utils.config import OptimizerConfig, get_preset
+
+
+def test_cifar_piecewise_matches_reference():
+    """0.1 until 40k, 0.01 until 60k, 0.001 until 80k, then 0.0001
+    (reference resnet_cifar_main.py:298-307)."""
+    s = piecewise((40000, 60000, 80000), (0.1, 0.01, 0.001, 0.0001))
+    for step, want in [(0, 0.1), (39999, 0.1), (40000, 0.01), (59999, 0.01),
+                       (60000, 0.001), (80000, 0.0001), (200000, 0.0001)]:
+        assert np.isclose(float(s(step)), want), (step, float(s(step)))
+
+
+def test_imagenet_warmup_piecewise_matches_reference():
+    """Linear warmup 0.1→0.4 over 6240 steps, then ×0.1 drops
+    (reference resnet_imagenet_main.py:236-247)."""
+    s = warmup_piecewise(6240, 0.1, 0.4, (37440, 74880, 99840),
+                         (0.4, 0.04, 0.004, 0.0004))
+    assert np.isclose(float(s(0)), 0.1)
+    assert np.isclose(float(s(3120)), 0.25, atol=1e-4)   # halfway
+    assert np.isclose(float(s(6240)), 0.4)
+    assert np.isclose(float(s(37439)), 0.4)
+    assert np.isclose(float(s(37440)), 0.04)
+    assert np.isclose(float(s(74880)), 0.004)
+    assert np.isclose(float(s(99840)), 0.0004)
+
+
+def test_piecewise_validation():
+    with pytest.raises(ValueError):
+        piecewise((10,), (0.1,))
+
+
+def test_schedule_factory_from_presets():
+    cifar = create_schedule(get_preset("cifar10_resnet50").optimizer)
+    assert np.isclose(float(cifar(50000)), 0.01)
+    imnet = create_schedule(get_preset("imagenet_resnet50").optimizer)
+    assert np.isclose(float(imnet(6240)), 0.4)
+    cos = create_schedule(OptimizerConfig(schedule="cosine", learning_rate=1.0,
+                                          warmup_steps=10, total_steps=100))
+    assert float(cos(10)) == pytest.approx(1.0, abs=1e-3)
+    assert float(cos(100)) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_schedule_is_jittable():
+    import jax
+    s = create_schedule(get_preset("cifar10_resnet50").optimizer)
+    f = jax.jit(s)
+    assert np.isclose(float(f(jnp.asarray(45000))), 0.01)
