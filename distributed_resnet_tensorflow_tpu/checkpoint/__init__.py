@@ -1,0 +1,1 @@
+from .manager import CheckpointManager, wait_for_new_checkpoint  # noqa: F401

@@ -1,0 +1,176 @@
+"""ImageNet input pipeline over TFRecord shards.
+
+Parity with the reference's duplicated input_fn/record_parser
+(reference resnet_imagenet_main.py:103-183, resnet_imagenet_eval.py:70-150):
+  * shard naming train-{i:05d}-of-01024 / validation-{i:05d}-of-00128
+    (reference :106-112),
+  * Example parsing of image/encoded + image/class/label
+    (reference record_parser:115-136; bbox features parsed but unused by the
+    crop the reference actually applied — VGG preprocessing ignores them),
+  * file-level shuffle each epoch + sample-level shuffle buffer
+    (reference :98-99,163,174),
+  * VGG preprocess train/eval (preprocessing.py), labels already 1-based
+    with 0 = background ⇒ num_classes=1001 dense ids (the reference one-hotted
+    to 1001, resnet_imagenet_main.py:151-155; we keep dense ids and one-hot
+    in the loss).
+
+Multi-process sharding: each process reads files[shard_index::num_shards] —
+disjoint by construction (the reference's Horovod path read everything
+everywhere, SURVEY.md §3.2).
+
+Parallelism: a pool of decode threads (PIL releases the GIL for JPEG work)
+feeding a bounded queue — host-side successor of tf.data's
+num_parallel_calls=5 map (reference :166-168). For the highest-rate path use
+the C++ native loader (data/native_loader.py) when built.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import queue as queue_mod
+import threading
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .preprocessing import preprocess_for_eval, preprocess_for_train, decode_jpeg
+from .tfrecord import parse_example, read_tfrecords
+
+TRAIN_SHARDS = 1024   # reference resnet_imagenet_main.py:106
+VAL_SHARDS = 128      # reference resnet_imagenet_main.py:111
+SHUFFLE_BUFFER = 1500  # reference resnet_imagenet_main.py:174
+
+
+def dataset_filenames(data_dir: str, mode: str) -> List[str]:
+    """Accept both the exact reference naming and any train-*/validation-*
+    TFRecord layout present in data_dir."""
+    prefix = "train" if mode == "train" else "validation"
+    files = sorted(glob.glob(os.path.join(data_dir, f"{prefix}-*")))
+    if not files:
+        raise FileNotFoundError(
+            f"no {prefix}-* TFRecord shards under {data_dir!r}")
+    return files
+
+
+def _example_to_sample(features: Dict) -> Optional[tuple]:
+    enc = features.get("image/encoded")
+    label = features.get("image/class/label")
+    if not enc or label is None or len(label) == 0:
+        return None
+    return bytes(enc[0]), int(label[0])
+
+
+def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
+                      image_size: int = 224, seed: int = 0,
+                      shard_index: int = 0, num_shards: int = 1,
+                      num_decode_threads: int = 4,
+                      prefetch_batches: int = 2,
+                      shuffle_buffer: int = SHUFFLE_BUFFER,
+                      ) -> Iterator[Dict[str, np.ndarray]]:
+    files = dataset_filenames(data_dir, mode)
+    if num_shards > 1:
+        total_files = len(files)
+        files = files[shard_index::num_shards]
+        if not files:
+            raise ValueError(f"process {shard_index}: no files to read "
+                             f"({num_shards} shards over {total_files} files)")
+    is_train = mode == "train"
+    rng = np.random.RandomState(seed + shard_index)
+
+    # stage 1: raw (jpeg_bytes, label) stream with file + buffer shuffle
+    def raw_stream():
+        epoch = 0
+        while True:
+            order = rng.permutation(len(files)) if is_train else range(len(files))
+            buf: List[tuple] = []
+            for fi in order:
+                for rec in read_tfrecords(files[fi]):
+                    sample = _example_to_sample(parse_example(rec))
+                    if sample is None:
+                        continue
+                    if is_train and shuffle_buffer > 1:
+                        buf.append(sample)
+                        if len(buf) >= shuffle_buffer:
+                            j = rng.randint(len(buf))
+                            yield buf.pop(j)
+                    else:
+                        yield sample
+            while buf:
+                j = rng.randint(len(buf))
+                yield buf.pop(j)
+            epoch += 1
+            if not is_train:
+                return
+
+    # stage 2: parallel decode+preprocess workers
+    in_q: queue_mod.Queue = queue_mod.Queue(maxsize=4 * batch_size)
+    out_q: queue_mod.Queue = queue_mod.Queue(
+        maxsize=max(2, prefetch_batches) * batch_size)
+    stop = threading.Event()
+    END = object()
+
+    def feeder():
+        try:
+            for sample in raw_stream():
+                if stop.is_set():
+                    return
+                in_q.put(sample)
+            for _ in range(num_decode_threads):
+                in_q.put(END)
+        except BaseException as e:
+            out_q.put(e)
+
+    def decoder(widx: int):
+        wrng = np.random.RandomState(seed * 7919 + widx)
+        try:
+            while not stop.is_set():
+                item = in_q.get()
+                if item is END:
+                    out_q.put(END)
+                    return
+                data, label = item
+                img = decode_jpeg(data)
+                if is_train:
+                    img = preprocess_for_train(img, wrng, image_size)
+                else:
+                    img = preprocess_for_eval(img, image_size)
+                out_q.put((img, label))
+        except BaseException as e:
+            out_q.put(e)
+
+    threading.Thread(target=feeder, daemon=True).start()
+    for i in range(num_decode_threads):
+        threading.Thread(target=decoder, args=(i,), daemon=True).start()
+
+    def batches():
+        images = np.empty((batch_size, image_size, image_size, 3), np.float32)
+        labels = np.empty((batch_size,), np.int32)
+        fill = 0
+        ended = 0
+        try:
+            while True:
+                item = out_q.get()
+                if isinstance(item, BaseException):
+                    raise RuntimeError("imagenet pipeline worker failed") from item
+                if item is END:
+                    ended += 1
+                    if ended == num_decode_threads:
+                        if fill and not is_train:
+                            # final partial eval batch: pad + mask
+                            mask = np.zeros((batch_size,), np.float32)
+                            mask[:fill] = 1.0
+                            images[fill:] = 0.0
+                            labels[fill:] = 0
+                            yield {"images": images.copy(),
+                                   "labels": labels.copy(), "mask": mask}
+                        return
+                    continue
+                images[fill], labels[fill] = item
+                fill += 1
+                if fill == batch_size:
+                    yield {"images": images.copy(), "labels": labels.copy()}
+                    fill = 0
+        finally:
+            stop.set()
+
+    return batches()

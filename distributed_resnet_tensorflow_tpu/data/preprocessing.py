@@ -1,0 +1,96 @@
+"""VGG-style ImageNet preprocessing — numpy/PIL re-expression.
+
+Parity with reference vgg_preprocessing.py:
+  * train: resize shorter side to a random scale in [256, 512]
+    (reference :284-314), random 224x224 crop (reference _random_crop:88),
+    random horizontal flip, RGB mean subtraction with means scaled to the
+    [0,1] pixel range (reference :37-39: _R_MEAN=123.68/255 etc.)
+  * eval: resize shorter side to 256, central 224x224 crop
+    (reference preprocess_for_eval:317-333)
+
+Decoding + resizing happen on the host (PIL), the cheap float ops in numpy;
+the TPU sees ready, fixed-shape float32 NHWC batches.
+"""
+from __future__ import annotations
+
+import io
+from typing import Optional, Tuple
+
+import numpy as np
+
+# reference vgg_preprocessing.py:37-39 (means already divided by 255)
+R_MEAN = 123.68 / 255.0
+G_MEAN = 116.78 / 255.0
+B_MEAN = 103.94 / 255.0
+RGB_MEANS = np.asarray([R_MEAN, G_MEAN, B_MEAN], np.float32)
+
+RESIZE_SIDE_MIN = 256   # reference vgg_preprocessing.py:41-42
+RESIZE_SIDE_MAX = 512
+DEFAULT_IMAGE_SIZE = 224
+
+
+def decode_jpeg(data: bytes) -> np.ndarray:
+    """JPEG/PNG bytes → RGB uint8 HWC."""
+    from PIL import Image
+    img = Image.open(io.BytesIO(data))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    return np.asarray(img, np.uint8)
+
+
+def encode_jpeg(image: np.ndarray, quality: int = 90) -> bytes:
+    """RGB uint8 HWC → JPEG bytes (test fixtures / dataset tooling)."""
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(image, "RGB").save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _aspect_preserving_resize(image: np.ndarray, smaller_side: int) -> np.ndarray:
+    """reference _aspect_preserving_resize:259-281."""
+    from PIL import Image
+    h, w = image.shape[:2]
+    scale = smaller_side / min(h, w)
+    nh, nw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
+    if (nh, nw) == (h, w):
+        return image
+    out = Image.fromarray(image).resize((nw, nh), Image.BILINEAR)
+    return np.asarray(out, np.uint8)
+
+
+def preprocess_for_train(image: np.ndarray, rng: np.random.RandomState,
+                         output_size: int = DEFAULT_IMAGE_SIZE,
+                         resize_side_min: int = RESIZE_SIDE_MIN,
+                         resize_side_max: int = RESIZE_SIDE_MAX) -> np.ndarray:
+    """reference preprocess_for_train:284-314."""
+    side = rng.randint(resize_side_min, resize_side_max + 1)
+    image = _aspect_preserving_resize(image, side)
+    h, w = image.shape[:2]
+    top = rng.randint(0, h - output_size + 1)
+    left = rng.randint(0, w - output_size + 1)
+    crop = image[top:top + output_size, left:left + output_size]
+    if rng.rand() < 0.5:
+        crop = crop[:, ::-1]
+    return crop.astype(np.float32) / 255.0 - RGB_MEANS
+
+
+def preprocess_for_eval(image: np.ndarray,
+                        output_size: int = DEFAULT_IMAGE_SIZE,
+                        resize_side: int = RESIZE_SIDE_MIN) -> np.ndarray:
+    """reference preprocess_for_eval:317-333."""
+    image = _aspect_preserving_resize(image, resize_side)
+    h, w = image.shape[:2]
+    top = (h - output_size) // 2
+    left = (w - output_size) // 2
+    crop = image[top:top + output_size, left:left + output_size]
+    return crop.astype(np.float32) / 255.0 - RGB_MEANS
+
+
+def preprocess_image(image: np.ndarray, is_training: bool,
+                     rng: Optional[np.random.RandomState] = None,
+                     output_size: int = DEFAULT_IMAGE_SIZE) -> np.ndarray:
+    """reference preprocess_image:336-363 dispatch."""
+    if is_training:
+        return preprocess_for_train(image, rng or np.random.RandomState(),
+                                    output_size)
+    return preprocess_for_eval(image, output_size)

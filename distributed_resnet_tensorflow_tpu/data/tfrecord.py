@@ -1,0 +1,233 @@
+"""TFRecord container + tf.train.Example wire-format codec — dependency-free.
+
+The reference consumed ImageNet as 1024 train / 128 validation TFRecord shards
+of tf.train.Example protos (reference resnet_imagenet_main.py:103-136). This
+module re-implements just enough of both formats in pure numpy/python so the
+framework needs neither TensorFlow nor protoc at runtime: a TFRecord
+reader/writer (with masked CRC32C), and an Example parser/builder speaking the
+protobuf wire format directly.
+
+TFRecord framing (per record):
+    uint64 length | uint32 masked_crc32c(length) | bytes data |
+    uint32 masked_crc32c(data)
+
+Example proto schema (subset the reference's record_parser touched,
+reference resnet_imagenet_main.py:117-136):
+    Example       { 1: Features }
+    Features      { 1: repeated map entry { 1: key(str), 2: Feature } }
+    Feature       { 1: BytesList, 2: FloatList, 3: Int64List }
+    BytesList     { 1: repeated bytes }
+    FloatList     { 1: repeated float (packed) }
+    Int64List     { 1: repeated varint (packed or unpacked) }
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli) with the TFRecord masking, table-driven
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = None
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        table = np.zeros(256, np.uint32)
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if (c & 1) else (c >> 1)
+            table[i] = c
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    # table-driven; sequential by nature (python-speed — fine for fixtures
+    # and spot checks; the C++ native loader owns the high-rate path)
+    tbl = _crc_table()
+    crc_val = 0xFFFFFFFF
+    for b in data:
+        crc_val = (crc_val >> 8) ^ int(tbl[(crc_val ^ b) & 0xFF])
+    return crc_val ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# TFRecord container
+# ---------------------------------------------------------------------------
+
+def read_tfrecords(path: str, verify_crc: bool = False) -> Iterator[bytes]:
+    """Yield raw record payloads from one TFRecord file."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:12])
+            if verify_crc and masked_crc32c(header[:8]) != len_crc:
+                raise IOError(f"{path}: corrupt length crc")
+            data = f.read(length)
+            if len(data) < length:
+                raise IOError(f"{path}: truncated record")
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if verify_crc and masked_crc32c(data) != data_crc:
+                raise IOError(f"{path}: corrupt data crc")
+            yield data
+
+
+def write_tfrecords(path: str, records: List[bytes]) -> None:
+    """Write records with proper masked CRCs (test fixture + export path)."""
+    with open(path, "wb") as f:
+        for rec in records:
+            header = struct.pack("<Q", len(rec))
+            f.write(header)
+            f.write(struct.pack("<I", masked_crc32c(header)))
+            f.write(rec)
+            f.write(struct.pack("<I", masked_crc32c(rec)))
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire helpers
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _iter_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message buffer."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:       # varint
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:     # 64-bit
+            val = buf[pos:pos + 8]; pos += 8
+        elif wire == 2:     # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]; pos += ln
+        elif wire == 5:     # 32-bit
+            val = buf[pos:pos + 4]; pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+FeatureValue = Union[List[bytes], List[float], List[int]]
+
+
+def parse_example(buf: bytes) -> Dict[str, FeatureValue]:
+    """Parse a serialized tf.train.Example into {key: list-of-values}."""
+    features: Dict[str, FeatureValue] = {}
+    for field, wire, val in _iter_fields(buf):
+        if field != 1 or wire != 2:     # Example.features
+            continue
+        for f2, w2, entry in _iter_fields(val):
+            if f2 != 1 or w2 != 2:      # Features.feature map entry
+                continue
+            key = None
+            feature = None
+            for f3, w3, v3 in _iter_fields(entry):
+                if f3 == 1:
+                    key = v3.decode("utf-8")
+                elif f3 == 2:
+                    feature = v3
+            if key is None or feature is None:
+                continue
+            features[key] = _parse_feature(feature)
+    return features
+
+
+def _parse_feature(buf: bytes) -> FeatureValue:
+    for field, wire, val in _iter_fields(buf):
+        if field == 1:      # BytesList
+            return [v for f, w, v in _iter_fields(val) if f == 1]
+        if field == 2:      # FloatList (packed or not)
+            floats: List[float] = []
+            for f, w, v in _iter_fields(val):
+                if f != 1:
+                    continue
+                if w == 2:  # packed
+                    floats.extend(np.frombuffer(v, "<f4").tolist())
+                else:       # single 32-bit
+                    floats.append(struct.unpack("<f", v)[0])
+            return floats
+        if field == 3:      # Int64List (packed or not)
+            ints: List[int] = []
+            for f, w, v in _iter_fields(val):
+                if f != 1:
+                    continue
+                if w == 2:  # packed varints
+                    pos = 0
+                    while pos < len(v):
+                        x, pos = _read_varint(v, pos)
+                        ints.append(x)
+                else:
+                    ints.append(v)
+            return ints
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Example builder (tests + dataset preparation tooling)
+# ---------------------------------------------------------------------------
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _write_varint((field << 3) | 2) + _write_varint(len(payload)) + payload
+
+
+def build_example(features: Dict[str, FeatureValue]) -> bytes:
+    """Serialize {key: values} to a tf.train.Example. Value kind inferred:
+    bytes→BytesList, float→FloatList, int→Int64List."""
+    entries = b""
+    for key, values in features.items():
+        if not isinstance(values, (list, tuple)):
+            values = [values]
+        if values and isinstance(values[0], (bytes, bytearray, str)):
+            items = b"".join(
+                _ld(1, v.encode() if isinstance(v, str) else bytes(v))
+                for v in values)
+            feature = _ld(1, items)
+        elif values and isinstance(values[0], float):
+            packed = np.asarray(values, "<f4").tobytes()
+            feature = _ld(2, _ld(1, packed))
+        else:
+            packed = b"".join(_write_varint(int(v)) for v in values)
+            feature = _ld(3, _ld(1, packed))
+        entries += _ld(1, _ld(1, key.encode()) + _ld(2, feature))
+    return _ld(1, entries)

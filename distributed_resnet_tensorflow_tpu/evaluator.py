@@ -1,0 +1,100 @@
+"""Standalone continuously-polling evaluator.
+
+Capability parity with reference resnet_cifar_eval.py / resnet_imagenet_eval.py
+(SURVEY.md §2.13): a separate process that (1) polls the checkpoint directory,
+(2) restores the newest checkpoint, (3) evaluates ``eval_batch_count`` batches,
+(4) tracks and reports best-so-far precision, tagging summaries with the
+TRAINER's global step, (5) sleeps and repeats — or runs once with
+``eval_once`` (reference resnet_cifar_eval.py:99-141).
+
+The trainer↔evaluator interface is the checkpoint directory only, exactly as
+in the reference (shared filesystem, separate SLURM node if desired).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterator, Optional
+
+from .checkpoint import CheckpointManager, wait_for_new_checkpoint
+from .train.loop import Trainer
+from .utils.metrics import MetricsWriter
+
+log = logging.getLogger(__name__)
+
+
+def make_eval_iterator(cfg):
+    """Fresh eval iterator, sharded per process so multi-host evaluation does
+    one global pass (each process contributes a disjoint slice of each global
+    batch instead of every process re-reading the full set)."""
+    import jax
+
+    from .data import create_input_iterator
+    nproc = jax.process_count()
+    return create_input_iterator(
+        cfg, mode="eval", shard_index=jax.process_index(), num_shards=nproc,
+        batch_size=max(1, cfg.data.eval_batch_size // nproc))
+
+
+class Evaluator:
+    def __init__(self, cfg, data_iter: Optional[Iterator] = None,
+                 writer: Optional[MetricsWriter] = None):
+        self.cfg = cfg
+        self.trainer = Trainer(cfg)
+        self.trainer.init_state()
+        from .utils.config import resolve_checkpoint_dir
+        self.manager = CheckpointManager(
+            resolve_checkpoint_dir(cfg), max_to_keep=1_000_000)
+        self.writer = writer
+        self.best_precision = 0.0   # reference best_precision tracking
+        self.last_step: Optional[int] = None
+        # a caller-supplied iterator is reused (must be infinite, e.g. the
+        # CIFAR/synthetic generators); config-built iterators are rebuilt per
+        # checkpoint because the ImageNet eval stream is one-pass
+        self._data_iter = data_iter
+
+    def _iter(self) -> Iterator:
+        if self._data_iter is not None:
+            return self._data_iter
+        return make_eval_iterator(self.cfg)
+
+    def evaluate_checkpoint(self, step: int) -> Dict[str, float]:
+        """Restore a specific checkpoint + run eval_batch_count batches
+        (reference ran 50 × bs=100, resnet_cifar_eval.py:111-122)."""
+        self.trainer.state, _ = self.manager.restore(self.trainer.state, step)
+        result = self.trainer.evaluate(self._iter(),
+                                       self.cfg.eval.eval_batch_count)
+        self.best_precision = max(self.best_precision, result["precision"])
+        result["best_precision"] = self.best_precision
+        self.last_step = step
+        if self.writer is not None:
+            # summaries tagged by the trainer's global step, like the
+            # reference (resnet_cifar_eval.py:125-133)
+            self.writer.write_scalars(step, {
+                "eval/precision": result["precision"],
+                "eval/best_precision": self.best_precision,
+                "eval/loss": result["loss"],
+            })
+        log.info("eval @ step %d: precision %.4f best %.4f loss %.4f",
+                 step, result["precision"], self.best_precision,
+                 result["loss"])
+        return result
+
+    def run(self, max_evals: Optional[int] = None,
+            timeout_secs: float = 0.0) -> Dict[str, float]:
+        """Poll-evaluate loop. ``eval_once`` (reference --eval_once flag) or
+        ``max_evals`` bound it; otherwise runs until no new checkpoint appears
+        within ``timeout_secs`` (0 = single pass over what exists)."""
+        result: Dict[str, float] = {}
+        n = 0
+        while True:
+            step = wait_for_new_checkpoint(
+                self.manager.directory, self.last_step,
+                timeout_secs=timeout_secs,
+                poll_secs=self.cfg.eval.poll_interval_secs)
+            if step is None:
+                log.info("no new checkpoint; evaluator exiting")
+                return result
+            result = self.evaluate_checkpoint(step)
+            n += 1
+            if self.cfg.eval.eval_once or (max_evals and n >= max_evals):
+                return result

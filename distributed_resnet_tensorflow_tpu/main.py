@@ -1,0 +1,169 @@
+"""CLI entry point — the successor of ALL the reference mains.
+
+One binary replaces resnet_cifar_main.py / resnet_imagenet_main.py /
+resnet_cifar_main_horovod.py / resnet_single.py / resnet_cifar_eval.py /
+resnet_imagenet_eval.py (SURVEY.md §1 L3): dataset and topology are config,
+not separate entry points, and there is no ps/worker split to dispatch on.
+
+Usage:
+    python -m distributed_resnet_tensorflow_tpu.main --preset cifar10_resnet50 \
+        --set train.batch_size=256 --set log_root=/tmp/run1
+    python -m distributed_resnet_tensorflow_tpu.main --preset cifar10_resnet50 \
+        --set mode=eval          # standalone polling evaluator
+
+Multi-host: launch one copy per TPU host (launcher.py / SLURM shim); every
+process runs this same SPMD program — replacing the reference's per-role
+process trees (reference resnet_cifar_main.py:339-399).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+import jax
+
+from .checkpoint import CheckpointManager
+from .data import create_input_iterator
+from .evaluator import Evaluator, make_eval_iterator
+from .parallel import initialize_from_config, is_chief
+from .train.hooks import CheckpointHook, LoggingHook, SummaryHook
+from .train.loop import Trainer
+from .utils.config import ExperimentConfig, parse_args, resolve_checkpoint_dir
+from .utils.metrics import MetricsWriter
+
+log = logging.getLogger(__name__)
+
+
+def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
+    """Build → (maybe) restore → train with hooks. Returns (state, metrics)."""
+    trainer = Trainer(cfg)
+    trainer.init_state()
+
+    manager = CheckpointManager(
+        resolve_checkpoint_dir(cfg), max_to_keep=cfg.checkpoint.max_to_keep,
+        save_every_steps=cfg.checkpoint.save_every_steps,
+        save_every_secs=cfg.checkpoint.save_every_secs,
+        async_save=cfg.checkpoint.async_save)
+
+    start_step = 0
+    if cfg.checkpoint.resume:
+        trainer.state, restored = manager.restore(trainer.state)
+        if restored is not None:
+            start_step = int(trainer.state.step)
+            log.info("resumed from checkpoint at step %d", start_step)
+
+    hooks = []
+    if is_chief():
+        hooks.append(LoggingHook(cfg.train.log_every_steps,
+                                 batch_size=cfg.train.batch_size,
+                                 print_fn=print))
+        writer = MetricsWriter(os.path.join(cfg.log_root, "train"))
+        hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
+    if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
+        hooks.append(CheckpointHook(manager))
+
+    # per-process input shard (fixes the reference Horovod path's unsharded
+    # input, SURVEY.md §3.2): each process reads 1/num_processes of the data
+    # and contributes local_batch = global/num_processes
+    nproc = jax.process_count()
+    per_process_bs = cfg.train.batch_size // nproc
+    data_iter = create_input_iterator(
+        cfg, mode="train", shard_index=jax.process_index(),
+        num_shards=nproc, batch_size=per_process_bs)
+
+    num_steps = max_steps if max_steps is not None else cfg.train.train_steps
+    state, metrics = trainer.train(data_iter, num_steps=num_steps,
+                                   hooks=tuple(hooks), start_step=start_step)
+    # final checkpoint + drain async saves
+    if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
+        manager.save(int(state.step), state, force=True)
+    manager.close()
+    return state, metrics
+
+
+def run_eval(cfg: ExperimentConfig, max_evals: Optional[int] = None,
+             timeout_secs: float = 0.0):
+    writer = None
+    if is_chief():
+        writer = MetricsWriter(os.path.join(cfg.log_root, "eval"))
+    ev = Evaluator(cfg, writer=writer)
+    return ev.run(max_evals=max_evals, timeout_secs=timeout_secs)
+
+
+def run_train_and_eval(cfg: ExperimentConfig):
+    """In-process alternation: train eval_every_steps, then eval (the
+    reference instead dedicated a whole node to the evaluator,
+    run_dist_train_eval_daint.sh:211-222 — that mode still exists via two
+    processes with mode=train / mode=eval)."""
+    trainer = Trainer(cfg)
+    trainer.init_state()
+    manager = CheckpointManager(
+        resolve_checkpoint_dir(cfg), max_to_keep=cfg.checkpoint.max_to_keep,
+        save_every_steps=cfg.checkpoint.save_every_steps,
+        save_every_secs=cfg.checkpoint.save_every_secs,
+        async_save=cfg.checkpoint.async_save)
+    if cfg.checkpoint.resume:
+        trainer.state, _ = manager.restore(trainer.state)
+
+    writer = MetricsWriter(os.path.join(cfg.log_root, "train")) if is_chief() else None
+    hooks = [CheckpointHook(manager)]
+    if is_chief():
+        hooks.append(LoggingHook(cfg.train.log_every_steps,
+                                 batch_size=cfg.train.batch_size,
+                                 print_fn=print))
+        if writer:
+            hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
+
+    nproc = jax.process_count()
+    train_iter = create_input_iterator(
+        cfg, mode="train", shard_index=jax.process_index(), num_shards=nproc,
+        batch_size=cfg.train.batch_size // nproc)
+
+    every = cfg.train.eval_every_steps or cfg.checkpoint.save_every_steps or 1000
+    best = 0.0
+    step = int(trainer.state.step)
+    result = {}
+    while step < cfg.train.train_steps:
+        target = min(step + every, cfg.train.train_steps)
+        state, _ = trainer.train(train_iter, num_steps=target,
+                                 hooks=tuple(hooks), start_step=step)
+        step = int(state.step)
+        # fresh iterator per round: the ImageNet eval stream is one-pass
+        result = trainer.evaluate(make_eval_iterator(cfg),
+                                  cfg.eval.eval_batch_count)
+        best = max(best, result["precision"])
+        if writer:
+            writer.write_scalars(step, {"eval/precision": result["precision"],
+                                        "eval/best_precision": best})
+        if is_chief():
+            print(f"eval @ step {step}: precision {result['precision']:.4f} "
+                  f"best {best:.4f}")
+    manager.save(step, trainer.state, force=True)
+    manager.close()
+    return trainer.state, {**result, "best_precision": best}
+
+
+def main(argv=None):
+    # force=True: absl/jax may have already claimed the root logger, which
+    # would otherwise swallow our INFO lines (e.g. the resume notice)
+    logging.basicConfig(
+        level=logging.INFO, force=True,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    cfg = parse_args(argv)
+    initialize_from_config(cfg.mesh)
+    log.info("devices: %d (%d processes)", jax.device_count(),
+             jax.process_count())
+    if cfg.mode == "train":
+        run_train(cfg)
+    elif cfg.mode == "eval":
+        run_eval(cfg, timeout_secs=0.0 if cfg.eval.eval_once else 86400.0)
+    elif cfg.mode == "train_and_eval":
+        run_train_and_eval(cfg)
+    else:
+        raise ValueError(f"unknown mode {cfg.mode!r}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

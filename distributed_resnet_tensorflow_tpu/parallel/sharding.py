@@ -60,6 +60,30 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
         lambda x: jax.device_put(x, sharding), batch)
 
 
+def pad_batch_to_multiple(batch: dict, multiple: int) -> dict:
+    """Pad the leading dim to a multiple of the batch-shard count, adding (or
+    extending) a float "mask" entry so padded rows don't count in metrics.
+    Needed because an eval batch (reference used 100, resnet_cifar_eval.py)
+    need not divide the device count."""
+    b = next(iter(batch.values())).shape[0]
+    rem = b % multiple
+    if rem == 0:
+        return batch
+    pad = multiple - rem
+    out = {}
+    for k, v in batch.items():
+        if k == "mask":
+            continue
+        pad_width = ((0, pad),) + ((0, 0),) * (v.ndim - 1)
+        out[k] = np.pad(np.asarray(v), pad_width)
+    mask = batch.get("mask")
+    if mask is None:
+        mask = np.ones((b,), np.float32)
+    out["mask"] = np.concatenate([np.asarray(mask),
+                                  np.zeros((pad,), np.float32)])
+    return out
+
+
 def make_global_batch(local_batch: Any, mesh: Mesh) -> Any:
     """Assemble a global jax.Array from per-process local data (multi-host)."""
     from .mesh import data_sharding

@@ -1,0 +1,75 @@
+"""Training hooks — plain host-side callbacks ``hook(step, state, metrics)``.
+
+Successor of the reference's session-hook stack (SURVEY.md §2.11-2.15):
+``LoggingTensorHook`` → LoggingHook, ``SummarySaverHook`` → SummaryHook,
+``MonitoredTrainingSession`` checkpointing → CheckpointHook,
+``_LearningRateSetterHook`` → gone (the LR schedule is computed inside the
+jitted step, no per-step host feed).
+
+Hooks receive device metrics WITHOUT forcing a sync: values are jax.Arrays;
+hooks that print/serialize pull them at their own cadence, so the hot loop
+stays async-dispatch bound, not host bound.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from ..utils.metrics import MetricsWriter, Throughput
+
+log = logging.getLogger(__name__)
+
+
+class LoggingHook:
+    """Print step/loss/precision/lr every N steps + throughput (reference
+    LoggingTensorHook cadence: 20 cifar / 40 imagenet,
+    resnet_cifar_main.py:280-285)."""
+
+    def __init__(self, every_steps: int = 20, batch_size: int = 0,
+                 print_fn=None):
+        self.every_steps = max(1, every_steps)
+        self.throughput = Throughput(batch_size)
+        self.print_fn = print_fn or (lambda s: log.info("%s", s))
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if step % self.every_steps != 0:
+            return
+        tp = self.throughput.update(step)
+        parts = [f"step {step}"]
+        for k in ("loss", "cross_entropy", "precision", "learning_rate"):
+            if k in metrics:
+                parts.append(f"{k} {float(metrics[k]):.4f}")
+        if tp:
+            parts.append(f"{tp['steps_per_sec']:.2f} stp/s")
+            if self.throughput.batch_size:
+                parts.append(f"{tp['images_per_sec']:.0f} img/s")
+        self.print_fn("  ".join(parts))
+
+
+class SummaryHook:
+    """Write scalars to the MetricsWriter every N steps (reference
+    SummarySaverHook every 100, resnet_cifar_main.py:274-278)."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if step % self.every_steps != 0:
+            return
+        scalars = {k: float(v) for k, v in metrics.items()
+                   if hasattr(v, "__float__") or isinstance(v, (int, float))}
+        self.writer.write_scalars(step, scalars)
+
+
+class CheckpointHook:
+    """Save via CheckpointManager on its step/time policy."""
+
+    def __init__(self, manager):
+        self.manager = manager
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        self.manager.maybe_save(step, state)

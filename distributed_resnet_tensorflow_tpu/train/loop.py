@@ -30,7 +30,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import create_mesh, data_sharding
-from ..parallel.sharding import shard_batch
+from ..parallel.sharding import make_global_batch, shard_batch
 from .optimizers import create_optimizer, loss_weight_decay
 from .schedules import create_schedule
 from .state import TrainState, create_train_state, state_shardings
@@ -175,6 +175,12 @@ class Trainer:
         self._jitted_train = None
         self._jitted_eval = None
         self.state: Optional[TrainState] = None
+        # single-process: device_put the full batch sharded; multi-process:
+        # every process contributes its local shard of the global array
+        if jax.process_count() > 1:
+            self._put_batch = lambda b: make_global_batch(b, self.mesh)
+        else:
+            self._put_batch = lambda b: shard_batch(b, self.mesh)
 
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:
@@ -215,18 +221,22 @@ class Trainer:
         metrics = None
         for step in range(start_step, num_steps):
             batch = next(data_iter)
-            batch = shard_batch(batch, self.mesh)
+            batch = self._put_batch(batch)
             self.state, metrics = step_fn(self.state, batch)
             for h in hooks:
                 h(step + 1, self.state, metrics)
         return self.state, metrics
 
     def evaluate(self, data_iter: Iterator, num_batches: int) -> Dict[str, float]:
+        from ..parallel.mesh import batch_shard_count
+        from ..parallel.sharding import pad_batch_to_multiple
         step_fn = self.jitted_eval_step()
+        n_shards = batch_shard_count(self.mesh)
         correct, count, loss_sum = 0, 0, 0.0
         for _ in range(num_batches):
             batch = next(data_iter)
-            batch = shard_batch(batch, self.mesh)
+            batch = pad_batch_to_multiple(batch, n_shards)
+            batch = self._put_batch(batch)
             out = step_fn(self.state, batch)
             correct += int(out["correct"])
             count += int(out["count"])

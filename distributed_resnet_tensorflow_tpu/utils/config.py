@@ -283,6 +283,14 @@ PRESETS = {
 }
 
 
+def resolve_checkpoint_dir(cfg: ExperimentConfig) -> str:
+    """Single source of truth for the checkpoint directory — trainer and
+    evaluator MUST agree (their only interface is this directory, as in the
+    reference, SURVEY.md §3.3)."""
+    import os
+    return cfg.checkpoint.directory or os.path.join(cfg.log_root, "ckpt")
+
+
 def get_preset(name: str) -> ExperimentConfig:
     if name not in PRESETS:
         raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")

@@ -1,0 +1,97 @@
+"""Metrics / observability.
+
+The reference's three channels (SURVEY.md §2.15, §5):
+  1. stdout logging every N steps (``LoggingTensorHook``,
+     reference resnet_cifar_main.py:280-285),
+  2. TensorBoard scalar summaries every 100 steps (``SummarySaverHook``,
+     reference resnet_cifar_main.py:274-278; scalars cross_entropy/cost/lr,
+     reference resnet_model.py:82-93),
+  3. per-process log files (reference run_dist_train_eval_daint.sh:161,188).
+
+Here: one ``MetricsWriter`` that fans out to a machine-readable JSONL event
+stream and (when available) TensorBoard via tensorboardX, plus a
+``Throughput`` meter giving steps/sec and images/sec — the number the
+reference only derived offline from log timestamps (SURVEY.md §6).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+class MetricsWriter:
+    """JSONL + optional TensorBoard scalar writer. Process-0-only by default
+    (matching chief-only summaries in the reference)."""
+
+    def __init__(self, logdir: str, enable_tensorboard: bool = True,
+                 filename: str = "metrics.jsonl"):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._jsonl = open(os.path.join(logdir, filename), "a", buffering=1)
+        self._tb = None
+        if enable_tensorboard:
+            try:
+                from tensorboardX import SummaryWriter
+                self._tb = SummaryWriter(logdir=logdir)
+            except Exception:  # tensorboardX optional
+                log.info("tensorboardX unavailable; JSONL metrics only")
+
+    def write_scalars(self, step: int, scalars: Dict[str, Any]) -> None:
+        rec = {"step": int(step), "time": time.time()}
+        for k, v in scalars.items():
+            rec[k] = float(v)
+        self._jsonl.write(json.dumps(rec) + "\n")
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, float(v), int(step))
+
+    def flush(self) -> None:
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+class Throughput:
+    """Steps/sec + images/sec meter over a sliding window."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+        self._t0: Optional[float] = None
+        self._step0: Optional[int] = None
+
+    def update(self, step: int) -> Dict[str, float]:
+        now = time.monotonic()
+        if self._t0 is None:
+            self._t0, self._step0 = now, step
+            return {}
+        dt = now - self._t0
+        dsteps = step - self._step0
+        if dt <= 0 or dsteps <= 0:
+            return {}
+        out = {"steps_per_sec": dsteps / dt,
+               "images_per_sec": dsteps * self.batch_size / dt}
+        self._t0, self._step0 = now, step
+        return out
+
+
+def read_metrics(logdir: str, filename: str = "metrics.jsonl"):
+    """Load the JSONL event stream back (for tests/analysis)."""
+    path = os.path.join(logdir, filename)
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

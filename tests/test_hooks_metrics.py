@@ -1,0 +1,73 @@
+"""Hooks + metrics writer tests (reference observability, SURVEY.md §2.15)."""
+import os
+
+import numpy as np
+
+from distributed_resnet_tensorflow_tpu.train.hooks import (
+    CheckpointHook, LoggingHook, SummaryHook)
+from distributed_resnet_tensorflow_tpu.utils.metrics import (
+    MetricsWriter, Throughput, read_metrics)
+
+
+def test_metrics_writer_jsonl_roundtrip(tmp_path):
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=False)
+    w.write_scalars(10, {"loss": 1.5, "precision": 0.5})
+    w.write_scalars(20, {"loss": 1.0, "precision": 0.7})
+    w.close()
+    recs = read_metrics(str(tmp_path))
+    assert len(recs) == 2
+    assert recs[0]["step"] == 10 and recs[0]["loss"] == 1.5
+    assert recs[1]["precision"] == 0.7
+
+
+def test_metrics_writer_tensorboard(tmp_path):
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=True)
+    w.write_scalars(1, {"loss": 2.0})
+    w.close()
+    # tensorboardX event file written alongside the jsonl
+    assert any(f.startswith("events") for f in os.listdir(tmp_path))
+
+
+def test_logging_hook_cadence():
+    lines = []
+    h = LoggingHook(every_steps=10, batch_size=128, print_fn=lines.append)
+    m = {"loss": np.float32(1.0), "precision": np.float32(0.5),
+         "learning_rate": np.float32(0.1)}
+    for step in range(1, 31):
+        h(step, None, m)
+    assert len(lines) == 3
+    assert "step 10" in lines[0] and "loss 1.0000" in lines[0]
+    # throughput appears once a window exists
+    assert "stp/s" in lines[1]
+
+
+def test_summary_hook_cadence(tmp_path):
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=False)
+    h = SummaryHook(w, every_steps=5)
+    for step in range(1, 11):
+        h(step, None, {"loss": float(step)})
+    w.close()
+    recs = read_metrics(str(tmp_path))
+    assert [r["step"] for r in recs] == [5, 10]
+
+
+def test_throughput_meter():
+    t = Throughput(batch_size=64)
+    assert t.update(0) == {}
+    import time
+    time.sleep(0.01)
+    out = t.update(10)
+    assert out["steps_per_sec"] > 0
+    assert np.isclose(out["images_per_sec"], out["steps_per_sec"] * 64)
+
+
+def test_checkpoint_hook_delegates(tmp_path):
+    calls = []
+
+    class FakeMngr:
+        def maybe_save(self, step, state):
+            calls.append(step)
+
+    h = CheckpointHook(FakeMngr())
+    h(7, "state", {})
+    assert calls == [7]

@@ -1,0 +1,70 @@
+"""CLI entry-point tests — the one binary replacing the reference's six mains
+(SURVEY.md §1 L3)."""
+import os
+
+import numpy as np
+
+from distributed_resnet_tensorflow_tpu import main as main_mod
+
+
+def _args(tmp_path, *extra):
+    return ["--preset", "smoke",
+            "--set", "model.compute_dtype=float32",
+            "--set", "model.resnet_size=8",
+            "--set", "data.image_size=8",
+            "--set", "train.batch_size=16",
+            "--set", f"log_root={tmp_path}",
+            "--set", f"checkpoint.directory={tmp_path}/ckpt",
+            "--set", "checkpoint.async_save=false",
+            *extra]
+
+
+def test_main_train_mode(tmp_path, capsys):
+    main_mod.main(_args(
+        tmp_path,
+        "--set", "train.train_steps=4",
+        "--set", "train.log_every_steps=2",
+        "--set", "checkpoint.save_every_steps=2",
+        "--set", "checkpoint.save_every_secs=0",
+    ))
+    out = capsys.readouterr().out
+    assert "step 2" in out and "step 4" in out
+    # checkpoints + metrics written
+    assert os.path.isdir(os.path.join(tmp_path, "ckpt"))
+    assert os.path.exists(os.path.join(tmp_path, "train", "metrics.jsonl"))
+
+
+def test_main_train_and_eval_mode(tmp_path, capsys):
+    main_mod.main(_args(
+        tmp_path,
+        "--set", "mode=train_and_eval",
+        "--set", "train.train_steps=4",
+        "--set", "train.eval_every_steps=2",
+        "--set", "eval.eval_batch_count=1",
+        "--set", "checkpoint.save_every_steps=2",
+        "--set", "checkpoint.save_every_secs=0",
+    ))
+    out = capsys.readouterr().out
+    assert "eval @ step 2" in out and "eval @ step 4" in out
+
+
+def test_main_eval_once_mode(tmp_path):
+    # first train + checkpoint...
+    main_mod.main(_args(
+        tmp_path,
+        "--set", "train.train_steps=2",
+        "--set", "checkpoint.save_every_steps=2",
+        "--set", "checkpoint.save_every_secs=0",
+    ))
+    # ...then one-shot evaluation against the written checkpoint
+    main_mod.main(_args(
+        tmp_path,
+        "--set", "mode=eval",
+        "--set", "eval.eval_once=true",
+        "--set", "eval.eval_batch_count=1",
+    ))
+    import json
+    path = os.path.join(tmp_path, "eval", "metrics.jsonl")
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    assert recs and "eval/precision" in recs[-1]
+    assert "eval/best_precision" in recs[-1]
