@@ -1,1 +1,7 @@
 from .batch_norm import GroupedBatchNorm  # noqa: F401
+from .attention import (  # noqa: F401
+    attention,
+    blockwise_attention,
+    ring_attention,
+    ring_attention_sharded,
+)

@@ -1,0 +1,159 @@
+"""Attention ops: dense reference, blockwise (flash-style) computation, and
+ring attention for sequence/context parallelism.
+
+The reference is a vision-only trainer with NO attention or sequence axis
+(SURVEY.md §5 'long-context': absent) — but its successor must treat long
+context as first-class. This module provides the sequence-parallel substrate:
+
+  * ``attention``          — dense softmax attention (numerical reference).
+  * ``blockwise_attention``— online-softmax accumulation over key/value
+    blocks (flash-attention recurrence) in pure lax; O(T) memory in the
+    sequence dimension instead of O(T²).
+  * ``ring_attention``     — the same recurrence where the key/value blocks
+    live on DIFFERENT devices along a ``seq`` mesh axis and rotate around the
+    ICI ring via ``lax.ppermute``; each device computes attention for its
+    query chunk against every kv chunk while only ever holding 1/N of the
+    sequence. Use under ``shard_map`` over a mesh with a ``seq`` axis (helper:
+    ``ring_attention_sharded``). Supports causal masking via global block
+    offsets.
+
+Design notes (jax-ml.github.io/scaling-book model): the ring pattern
+overlaps compute of block i with the ppermute of block i+1 — XLA schedules
+the collective-permute asynchronously; the loop is a ``lax.fori_loop`` so the
+whole ring is one compiled program.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = False) -> jax.Array:
+    """Dense reference attention. Shapes: (B, T, H, D) — batch, time, heads,
+    head_dim. fp32 softmax regardless of input dtype."""
+    b, tq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tk = k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _online_block(q, k, v, m, l, acc, scale, mask=None):
+    """One flash-attention accumulation step.
+
+    q: (B,Tq,H,D); k,v: (B,Tk,H,D); m,l: (B,H,Tq); acc: (B,Tq,H,D);
+    mask: (Tq,Tk) bool or None. All accumulation in fp32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows: exp(-inf - (-inf)) → use finite m
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        block_size: int = 512,
+                        causal: bool = False) -> jax.Array:
+    """Single-device flash-style attention via lax.fori_loop over kv blocks."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if tk % block_size != 0:
+        block_size = math.gcd(tk, block_size) or tk
+    nblocks = tk // block_size
+    scale = 1.0 / math.sqrt(d)
+
+    m0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    acc0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    # dense-reference convention: queries are the LAST tq positions of the
+    # key timeline (tril offset tk - tq), so suffix-query decode works
+    q_pos = jnp.arange(tq) + (tk - tq)
+
+    def body(i, carry):
+        m, l, acc = carry
+        kb = lax.dynamic_slice_in_dim(k, i * block_size, block_size, axis=1)
+        vb = lax.dynamic_slice_in_dim(v, i * block_size, block_size, axis=1)
+        mask = None
+        if causal:
+            k_pos = i * block_size + jnp.arange(block_size)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        return _online_block(q, kb, vb, m, l, acc, scale, mask)
+
+    m, l, acc = lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l.transpose(0, 2, 1)[..., None]).astype(v.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = False) -> jax.Array:
+    """Sequence-parallel attention over a named mesh axis (call under
+    shard_map with q/k/v sharded on the time dimension).
+
+    Local shapes: (B, T_local, H, D). Device j initially holds kv chunk j;
+    at ring step i it processes kv chunk (j - i) mod N and forwards its
+    current chunk to device (j + 1) mod N.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    m0 = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    q_pos = my * t_local + jnp.arange(t_local)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def accumulate(i, m, l, acc, k_cur, v_cur):
+        src = (my - i) % n  # global chunk index of the kv we currently hold
+        k_pos = src * t_local + jnp.arange(t_local)
+        mask = q_pos[:, None] >= k_pos[None, :] if causal else None
+        return _online_block(q, k_cur, v_cur, m, l, acc, scale, mask)
+
+    def body(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        m, l, acc = accumulate(i, m, l, acc, k_cur, v_cur)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m, l, acc, k_nxt, v_nxt
+
+    # ring for n-1 steps, then the final chunk without a wasted ppermute
+    m, l, acc, k_last, v_last = lax.fori_loop(
+        0, n - 1, body, (m0, l0, acc0, k, v))
+    m, l, acc = accumulate(n - 1, m, l, acc, k_last, v_last)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l.transpose(0, 2, 1)[..., None]).astype(v.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh, causal: bool = False,
+                           seq_axis: str = "seq") -> jax.Array:
+    """Convenience wrapper: shard_map ring_attention over ``mesh[seq_axis]``
+    with time-dim sharding (B, T/seq, H, D per device)."""
+    from jax import shard_map
+
+    spec = P(None, seq_axis, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,2 @@
+from .softmax_xent import softmax_xent, softmax_xent_mean  # noqa: F401
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,170 @@
+"""Flash attention — Pallas TPU kernel (forward) with recompute backward.
+
+Canonical TPU tiling: grid (batch·heads, q_blocks, k_blocks) with the k-block
+dimension innermost and sequential ("arbitrary" semantics); online-softmax
+accumulators (m, l, acc) live in VMEM scratch and persist across the k-block
+iterations, so VMEM holds only one (block_q, d) query tile and one
+(block_k, d) key/value tile at a time — O(block) VMEM, any sequence length.
+Output is written on the last k iteration.
+
+The backward pass recomputes attention via the lax blockwise implementation
+(ops/attention.py) under ``jax.vjp`` — O(T) memory, one extra forward, no
+O(T²) residuals (flash-attention v1 strategy). A fused Pallas backward is the
+known next step.
+
+Layout: (B, T, H, D). The wrapper pads T up to lcm(block_q, block_k) and D to
+the 128-lane width; padded keys are masked via ``valid_len``, padded queries
+are sliced off. Causal masking uses the dense-attention convention: with
+tq == tk the diagonal, i.e. query i attends keys ≤ i.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend bits; fall back gracefully on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+    _HAVE_TPU_PARAMS = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = pl.ANY
+    _HAVE_TPU_PARAMS = False
+
+_NEG_INF = -1e30
+BLOCK_Q = 256
+BLOCK_K = 256
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale, causal, valid_len, block_q, block_k, nk):
+    """One (q-block, k-block) tile. Scratch m/l/acc persist across the
+    innermost (k-block) grid dimension."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # blocks strictly above the causal diagonal contribute nothing
+    live = jnp.logical_or(not causal,
+                          kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        if valid_len is not None:
+            s = jnp.where(k_pos < valid_len, s, _NEG_INF)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_prev * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal=False, interpret=False,
+                   block_q=BLOCK_Q, block_k=BLOCK_K):
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    step = math.lcm(block_q, block_k)
+    tpad = (-t) % step
+    dpad = (-d) % 128
+
+    def fold(x):  # (B,T,H,D) → (B·H, T, D)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    if tpad or dpad:
+        pad = ((0, 0), (0, tpad), (0, dpad))
+        qf, kf, vf = (jnp.pad(x, pad) for x in (qf, kf, vf))
+    tp, dp = qf.shape[1], qf.shape[2]
+    nq, nk = tp // block_q, tp // block_k
+    grid = (b * h, nq, nk)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        valid_len=(t if tpad else None), block_q=block_q, block_k=block_k,
+        nk=nk)
+
+    if not _HAVE_TPU_PARAMS:  # pragma: no cover
+        raise NotImplementedError(
+            "flash_attention requires the Pallas TPU backend; use "
+            "ops.blockwise_attention on this platform")
+    scratch = [pltpu.VMEM((block_q, 1), jnp.float32),
+               pltpu.VMEM((block_q, 1), jnp.float32),
+               pltpu.VMEM((block_q, dp), jnp.float32)]
+    extra = {}
+    if not interpret:
+        extra = dict(compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")))
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, i, j: (bh, i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda bh, i, j: (bh, j, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda bh, i, j: (bh, j, 0),
+                         memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, i, j: (bh, i, 0),
+                               memory_space=_VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, tp, dp), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **extra,
+    )(qf, kf, vf)
+    return out[:, :t, :d].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, interpret: bool = False) -> jax.Array:
+    """Pallas flash attention, (B, T, H, D). Differentiable: backward
+    recomputes via the lax blockwise path (O(T) memory)."""
+    return _flash_forward(q, k, v, causal, interpret)
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    return _flash_forward(q, k, v, causal, interpret), (q, k, v)
+
+
+def _fa_bwd(causal, interpret, res, g):
+    from ..attention import blockwise_attention
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: blockwise_attention(q, k, v,
+                                                         causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
