@@ -16,10 +16,12 @@ def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
         return cifar_iterator(d.dataset, d.data_dir, bs, mode,
                               seed=cfg.train.seed, shard_index=shard_index,
                               num_shards=num_shards,
-                              prefetch=d.prefetch_batches)
+                              prefetch=d.prefetch_batches,
+                              use_native=d.use_native_loader)
     if d.dataset == "imagenet":
         from .imagenet import imagenet_iterator
         return imagenet_iterator(d.data_dir, bs, mode, image_size=d.image_size,
                                  seed=cfg.train.seed, shard_index=shard_index,
-                                 num_shards=num_shards)
+                                 num_shards=num_shards,
+                                 use_native=d.use_native_loader)
     raise ValueError(f"unknown dataset {d.dataset!r}")

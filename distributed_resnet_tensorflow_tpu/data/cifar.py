@@ -59,17 +59,38 @@ def dataset_filenames(dataset: str, data_dir: str, mode: str) -> List[str]:
     return paths
 
 
-def load_cifar(dataset: str, data_dir: str, mode: str
-               ) -> Tuple[np.ndarray, np.ndarray]:
+def load_cifar(dataset: str, data_dir: str, mode: str,
+               use_native: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Parse raw records → (images uint8 NHWC, labels int32).
 
     Records store CHW planes; transpose to NHWC, the TPU-native layout
     (reference parse_record did the same transpose, resnet_cifar_main.py:157-182).
+    ``use_native`` parses in C++ (native/dataloader.cc) — identical output,
+    used for the high-rate path; falls back silently if the .so is absent.
     """
     label_bytes, label_offset = _record_layout(dataset)
     rec_len = label_bytes + _REC_IMG
+    paths = dataset_filenames(dataset, data_dir, mode)
+    # corrupt/truncated files must fail loudly on BOTH parsers (the C++
+    # fread loop would silently stop at a partial record)
+    for path in paths:
+        size = os.path.getsize(path)
+        if size % rec_len != 0:
+            raise ValueError(f"{path}: size {size} not a multiple of "
+                             f"record length {rec_len}")
+    if use_native:
+        from .native_loader import native_available
+        if native_available():
+            from .native_loader import load_cifar_native
+            imgs, lbls = [], []
+            for path in paths:
+                im, lb = load_cifar_native(path, label_bytes, label_offset)
+                imgs.append(im)
+                lbls.append(lb)
+            return np.concatenate(imgs), np.concatenate(lbls)
+        # no toolchain/.so → behavior-identical python parser below
     images, labels = [], []
-    for path in dataset_filenames(dataset, data_dir, mode):
+    for path in paths:
         raw = np.fromfile(path, dtype=np.uint8)
         if raw.size % rec_len != 0:
             raise ValueError(f"{path}: size {raw.size} not a multiple of "
@@ -121,14 +142,15 @@ def augment_train(images: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
 
 def cifar_iterator(dataset: str, data_dir: str, batch_size: int, mode: str,
                    seed: int = 0, shard_index: int = 0, num_shards: int = 1,
-                   prefetch: int = 2) -> Iterator[Dict[str, np.ndarray]]:
+                   prefetch: int = 2, use_native: bool = False
+                   ) -> Iterator[Dict[str, np.ndarray]]:
     """In-memory epoch iterator with full-dataset shuffle per epoch (the
     reference shuffled a 50k buffer = full epoch, resnet_cifar_main.py:221).
 
     ``shard_index/num_shards`` give each process a disjoint slice — fixing the
     reference Horovod path's unsharded input (SURVEY.md §3.2).
     """
-    images, labels = load_cifar(dataset, data_dir, mode)
+    images, labels = load_cifar(dataset, data_dir, mode, use_native=use_native)
     if num_shards > 1:
         images = images[shard_index::num_shards]
         labels = labels[shard_index::num_shards]

@@ -66,6 +66,7 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                       num_decode_threads: int = 4,
                       prefetch_batches: int = 2,
                       shuffle_buffer: int = SHUFFLE_BUFFER,
+                      use_native: bool = False,
                       ) -> Iterator[Dict[str, np.ndarray]]:
     files = dataset_filenames(data_dir, mode)
     if num_shards > 1:
@@ -77,24 +78,46 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
     is_train = mode == "train"
     rng = np.random.RandomState(seed + shard_index)
 
+    # native C++ multithreaded record reader for the high-rate train path
+    # (file order is thread-interleaved → extra shuffle for free; eval keeps
+    # the deterministic python reader)
+    native = use_native and is_train
+    if native:
+        try:
+            from .native_loader import NativePrefetcher, native_available
+            native = native_available()
+        except Exception:
+            native = False
+
+    def record_stream(ordered_files):
+        if native:
+            pf = NativePrefetcher(list(ordered_files),
+                                  num_threads=min(4, len(ordered_files)))
+            try:
+                yield from pf
+            finally:
+                pf.close()
+        else:
+            for path in ordered_files:
+                yield from read_tfrecords(path)
+
     # stage 1: raw (jpeg_bytes, label) stream with file + buffer shuffle
     def raw_stream():
         epoch = 0
         while True:
             order = rng.permutation(len(files)) if is_train else range(len(files))
             buf: List[tuple] = []
-            for fi in order:
-                for rec in read_tfrecords(files[fi]):
-                    sample = _example_to_sample(parse_example(rec))
-                    if sample is None:
-                        continue
-                    if is_train and shuffle_buffer > 1:
-                        buf.append(sample)
-                        if len(buf) >= shuffle_buffer:
-                            j = rng.randint(len(buf))
-                            yield buf.pop(j)
-                    else:
-                        yield sample
+            for rec in record_stream([files[fi] for fi in order]):
+                sample = _example_to_sample(parse_example(rec))
+                if sample is None:
+                    continue
+                if is_train and shuffle_buffer > 1:
+                    buf.append(sample)
+                    if len(buf) >= shuffle_buffer:
+                        j = rng.randint(len(buf))
+                        yield buf.pop(j)
+                else:
+                    yield sample
             while buf:
                 j = rng.randint(len(buf))
                 yield buf.pop(j)

@@ -1,0 +1,158 @@
+"""ctypes bindings for the native C++ data loader (native/dataloader.cc).
+
+The C++ tier replaces what the reference got from TensorFlow's native input
+runtime (queue runners / tf.data C++, SURVEY.md L0-L1): CRC32C, CIFAR binary
+parsing, and a multithreaded TFRecord prefetcher with a bounded ring buffer.
+
+Auto-builds with ``make`` on first use if a toolchain is present; callers can
+always fall back to the pure-python paths (data/cifar.py, data/tfrecord.py),
+which are behavior-identical (tests assert this).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libdrtdata.so"))
+_lib = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception as e:  # toolchain missing etc.
+        log.info("native loader build failed: %s", e)
+        return False
+
+
+def load_library(auto_build: bool = True) -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO_PATH):
+        if not (auto_build and _build()):
+            raise NativeUnavailable(
+                f"{_SO_PATH} not built (run `make -C {_NATIVE_DIR}`)")
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.drt_crc32c.restype = ctypes.c_uint32
+    lib.drt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.drt_masked_crc32c.restype = ctypes.c_uint32
+    lib.drt_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.drt_cifar_load.restype = ctypes.c_int64
+    lib.drt_cifar_load.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64]
+    lib.drt_prefetch_create.restype = ctypes.c_void_p
+    lib.drt_prefetch_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32]
+    lib.drt_prefetch_next.restype = ctypes.c_int64
+    lib.drt_prefetch_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.drt_prefetch_crc_errors.restype = ctypes.c_int64
+    lib.drt_prefetch_crc_errors.argtypes = [ctypes.c_void_p]
+    lib.drt_prefetch_destroy.restype = None
+    lib.drt_prefetch_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    try:
+        load_library()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def crc32c(data: bytes) -> int:
+    return load_library().drt_crc32c(data, len(data))
+
+
+def masked_crc32c(data: bytes) -> int:
+    return load_library().drt_masked_crc32c(data, len(data))
+
+
+def load_cifar_native(path: str, label_bytes: int, label_offset: int,
+                      max_records: int = 60000
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR binary file → (HWC uint8 images, int32 labels), parsed in C++."""
+    lib = load_library()
+    images = np.empty((max_records, 32, 32, 3), np.uint8)
+    labels = np.empty((max_records,), np.int32)
+    n = lib.drt_cifar_load(
+        path.encode(), label_bytes, label_offset,
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        max_records)
+    if n < 0:
+        raise FileNotFoundError(path)
+    return images[:n].copy(), labels[:n].copy()
+
+
+class NativePrefetcher:
+    """Iterate raw TFRecord payloads produced by C++ reader threads."""
+
+    def __init__(self, paths: List[str], num_threads: int = 4,
+                 capacity: int = 512, verify_crc: bool = False):
+        self._lib = load_library()
+        arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+        self._handle = self._lib.drt_prefetch_create(
+            arr, len(paths), num_threads, capacity, int(verify_crc))
+        if not self._handle:
+            raise NativeUnavailable("prefetcher creation failed")
+        self._buf = np.empty(1 << 20, np.uint8)  # 1 MB, grown on demand
+
+    def __iter__(self) -> Iterator[bytes]:
+        return self
+
+    def __next__(self) -> bytes:
+        if self._handle is None:
+            raise StopIteration
+        needed = ctypes.c_int64(0)
+        while True:
+            n = self._lib.drt_prefetch_next(
+                self._handle,
+                self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self._buf.size, ctypes.byref(needed))
+            if n == 0:
+                raise StopIteration
+            if n == -1:
+                self._buf = np.empty(int(needed.value) * 2, np.uint8)
+                continue
+            return bytes(self._buf[:n])
+
+    @property
+    def crc_errors(self) -> int:
+        if self._handle is None:
+            return self._final_crc_errors
+        return self._lib.drt_prefetch_crc_errors(self._handle)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._final_crc_errors = self._lib.drt_prefetch_crc_errors(
+                self._handle)
+            self._lib.drt_prefetch_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,91 @@
+"""Multi-process launcher — successor of the reference's launcher tree.
+
+The reference bootstrapped clusters with ~440 lines of bash deriving ps/worker
+host:port lists from SLURM and synthesizing per-node scripts
+(reference scripts/run_dist_tf_daint.sh:30-206, SURVEY.md §2.18). In the SPMD
+world a launcher only needs to start N identical processes with
+(coordinator, process_id) — everything else is the same program.
+
+Modes:
+  * ``--num_processes N`` local fan-out — the successor of the reference's
+    1ps+2wk localhost smoke cluster (reference scripts/submit_mac_dist.sh,
+    run_dist_tf_local.sh: bs=10, 100 steps on CPU). Each child gets a fake
+    single-CPU-device platform unless --devices_per_process says otherwise.
+  * under SLURM, don't use this at all: ``srun python -m
+    distributed_resnet_tensorflow_tpu.main …`` — parallel/distributed.py
+    reads SLURM_NTASKS/SLURM_PROCID/nodelist itself (scripts/submit_tpu_slurm.sh).
+  * on Cloud TPU pods, run main.py on every TPU VM worker;
+    jax.distributed.initialize autodetects the pod topology (no args needed).
+
+Usage:
+    python -m distributed_resnet_tensorflow_tpu.launch --num_processes 2 -- \
+        --preset smoke --set train.train_steps=20
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+
+def launch_local(num_processes: int, main_args: List[str],
+                 devices_per_process: int = 1, port: int = 8476) -> int:
+    """Spawn N copies of main.py on localhost over the loopback coordinator.
+    Returns the first nonzero exit code (0 if all succeed)."""
+    procs = []
+    for pid in range(num_processes):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{devices_per_process}").strip()
+        cmd = [sys.executable, "-m", "distributed_resnet_tensorflow_tpu.main",
+               *main_args,
+               "--set", f"mesh.coordinator_address=127.0.0.1:{port}",
+               "--set", f"mesh.num_processes={num_processes}",
+               "--set", f"mesh.process_id={pid}"]
+        # chief inherits stdout/stderr; others keep their own log files —
+        # per-process logs like the reference's worker.$JOBID.$host.log
+        # (reference run_dist_train_eval_daint.sh:161,188)
+        if pid == 0:
+            out = None
+        else:
+            os.makedirs("/tmp/drt_launch", exist_ok=True)
+            out = open(f"/tmp/drt_launch/proc{pid}.log", "w")
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out, stderr=out))
+
+    rc = 0
+    try:
+        for p in procs:
+            code = p.wait()
+            rc = rc or code
+    except KeyboardInterrupt:  # kill.sh parity (reference scripts/kill.sh)
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        rc = 130
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="local multi-process SPMD launcher")
+    ap.add_argument("--num_processes", type=int, default=2)
+    ap.add_argument("--devices_per_process", type=int, default=1)
+    ap.add_argument("--port", type=int, default=8476)
+    ap.add_argument("main_args", nargs=argparse.REMAINDER,
+                    help="args after -- go to main.py")
+    ns = ap.parse_args(argv)
+    main_args = ns.main_args
+    if main_args and main_args[0] == "--":
+        main_args = main_args[1:]
+    sys.exit(launch_local(ns.num_processes, main_args,
+                          ns.devices_per_process, ns.port))
+
+
+if __name__ == "__main__":
+    main()

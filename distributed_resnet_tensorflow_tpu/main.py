@@ -151,6 +151,10 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO, force=True,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # honor JAX_PLATFORMS even when a site plugin (e.g. this environment's
+    # axon sitecustomize) overrode it via jax.config at interpreter start
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     cfg = parse_args(argv)
     initialize_from_config(cfg.mesh)
     log.info("devices: %d (%d processes)", jax.device_count(),

@@ -1,0 +1,5 @@
+#!/bin/bash
+# Parity with reference scripts/kill.sh (pkill python3) — scoped to this
+# framework's processes instead of every python on the node.
+pkill -f "distributed_resnet_tensorflow_tpu.main" || true
+pkill -f "distributed_resnet_tensorflow_tpu.launch" || true

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Local smoke run — successor of the reference's 1ps+2wk localhost cluster
+# (reference scripts/submit_mac_dist.sh + run_dist_tf_local.sh: CPU, bs=10,
+# 100 steps). Two SPMD processes over a loopback coordinator, synthetic data.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m distributed_resnet_tensorflow_tpu.launch --num_processes 2 -- \
+  --preset smoke \
+  --set train.batch_size=10 \
+  --set train.train_steps=100 \
+  --set train.log_every_steps=20 \
+  --set checkpoint.save_every_secs=0 \
+  --set checkpoint.save_every_steps=0 \
+  "$@"

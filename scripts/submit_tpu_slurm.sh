@@ -1,0 +1,32 @@
+#!/bin/bash
+# SLURM submit shim — successor of the reference's per-(machine x dataset x
+# backend) submit scripts (reference scripts/submit_cifar_daint_dist.sh etc.,
+# SURVEY.md §2.19). One script: preset + overrides come from the command line.
+#
+#   sbatch -N <nodes> scripts/submit_tpu_slurm.sh <preset> [--set k=v ...]
+#
+# Every task runs the same SPMD program; parallel/distributed.py derives
+# (coordinator, num_processes, process_id) from SLURM_* env vars — the ~200
+# lines of host-list bash from the reference launcher are gone.
+#SBATCH --job-name=drt-tpu
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=12:00:00
+
+set -euo pipefail
+
+PRESET="${1:-cifar10_resnet50}"
+shift || true
+
+LOG_ROOT="${LOG_ROOT:-logs/${SLURM_JOB_NAME:-drt}-${SLURM_JOB_ID:-local}}"
+mkdir -p "$LOG_ROOT"
+
+# reference parity: optional checkpoint wipe via FRESH=1
+# (reference submit_cifar_daint_dist.sh:67-73)
+if [[ "${FRESH:-0}" == "1" ]]; then
+  rm -rf "$LOG_ROOT/ckpt"
+fi
+
+srun --no-kill python -m distributed_resnet_tensorflow_tpu.main \
+  --preset "$PRESET" \
+  --set "log_root=$LOG_ROOT" \
+  "$@"

@@ -1,0 +1,38 @@
+"""Local multi-process launcher test — real 2-process SPMD over a loopback
+coordinator (successor of the reference's submit_mac_dist.sh smoke cluster,
+SURVEY.md §4.1)."""
+import socket
+import sys
+
+import pytest
+
+from distributed_resnet_tensorflow_tpu.launch import launch_local
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_spmd_train(tmp_path):
+    rc = launch_local(
+        num_processes=2,
+        main_args=[
+            "--preset", "smoke",
+            "--set", "model.name=logistic",
+            "--set", "model.input_size=192",   # 8*8*3
+            "--set", "model.num_classes=10",
+            "--set", "data.image_size=8",
+            "--set", "train.batch_size=16",  # 2 procs × 8 fake devices
+            "--set", "train.train_steps=6",
+            "--set", "train.log_every_steps=2",
+            "--set", f"log_root={tmp_path}",
+            "--set", "checkpoint.save_every_steps=0",
+            "--set", "checkpoint.save_every_secs=0",
+        ],
+        port=_free_port())
+    assert rc == 0

@@ -1,0 +1,110 @@
+"""Native C++ loader tests — behavior identical to the python parsers.
+
+Skipped wholesale if no C++ toolchain is available to build libdrtdata.so.
+"""
+import os
+
+import numpy as np
+import pytest
+
+nl = pytest.importorskip(
+    "distributed_resnet_tensorflow_tpu.data.native_loader")
+if not nl.native_available():
+    pytest.skip("native loader unavailable (no toolchain?)",
+                allow_module_level=True)
+
+from distributed_resnet_tensorflow_tpu.data.cifar import load_cifar
+from distributed_resnet_tensorflow_tpu.data.tfrecord import (
+    build_example, masked_crc32c, write_tfrecords)
+
+
+def test_native_crc_matches_python():
+    rng = np.random.RandomState(0)
+    for n in (0, 1, 7, 8, 9, 1000):
+        data = rng.bytes(n)
+        from distributed_resnet_tensorflow_tpu.data.tfrecord import crc32c
+        assert nl.crc32c(data) == crc32c(data), n
+        assert nl.masked_crc32c(data) == masked_crc32c(data), n
+
+
+def _write_cifar(tmp_path, dataset):
+    rng = np.random.RandomState(3)
+    lb = 1 if dataset == "cifar10" else 2
+    names = ([f"data_batch_{i}.bin" for i in range(1, 6)]
+             if dataset == "cifar10" else ["train.bin"])
+    for name in names:
+        recs = np.zeros((10, lb + 3072), np.uint8)
+        recs[:, :lb] = rng.randint(0, 100, (10, lb))
+        recs[:, lb:] = rng.randint(0, 256, (10, 3072))
+        recs.tofile(os.path.join(tmp_path, name))
+    return str(tmp_path)
+
+
+@pytest.mark.parametrize("dataset", ["cifar10", "cifar100"])
+def test_native_cifar_matches_python(tmp_path, dataset):
+    d = _write_cifar(tmp_path, dataset)
+    im_py, lb_py = load_cifar(dataset, d, "train", use_native=False)
+    im_c, lb_c = load_cifar(dataset, d, "train", use_native=True)
+    np.testing.assert_array_equal(im_py, im_c)
+    np.testing.assert_array_equal(lb_py, lb_c)
+
+
+def test_native_prefetcher_reads_all_records(tmp_path):
+    rng = np.random.RandomState(1)
+    want = set()
+    paths = []
+    for s in range(3):
+        recs = []
+        for i in range(20):
+            payload = bytes([s, i]) + rng.bytes(50)
+            recs.append(payload)
+            want.add(payload)
+        path = os.path.join(tmp_path, f"shard-{s}")
+        write_tfrecords(path, recs)
+        paths.append(path)
+    pf = nl.NativePrefetcher(paths, num_threads=2, verify_crc=True)
+    got = set(pf)
+    pf.close()
+    assert got == want
+    assert pf.crc_errors == 0
+
+
+def test_native_prefetcher_skips_corrupt_records(tmp_path):
+    path = os.path.join(tmp_path, "bad")
+    write_tfrecords(path, [b"good-one", b"bad-rec!", b"good-two"])
+    raw = bytearray(open(path, "rb").read())
+    # corrupt the middle record's payload (offset: 12 hdr + 8 data + 4 crc + 12 hdr)
+    raw[12 + 8 + 4 + 12 + 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    pf = nl.NativePrefetcher([path], num_threads=1, verify_crc=True)
+    got = list(pf)
+    pf.close()
+    assert b"good-one" in got and b"good-two" in got
+    assert pf.crc_errors == 1
+
+
+def test_native_prefetcher_large_records(tmp_path):
+    """Records larger than the initial 1MB buffer trigger the regrow path."""
+    big = os.urandom(3 << 20)
+    path = os.path.join(tmp_path, "big")
+    write_tfrecords(path, [big])
+    pf = nl.NativePrefetcher([path], num_threads=1)
+    got = list(pf)
+    pf.close()
+    assert got == [big]
+
+
+def test_imagenet_iterator_native_path(tmp_path):
+    from distributed_resnet_tensorflow_tpu.data.imagenet import imagenet_iterator
+    from distributed_resnet_tensorflow_tpu.data.preprocessing import encode_jpeg
+    rng = np.random.RandomState(5)
+    recs = [build_example({
+        "image/encoded": [encode_jpeg(rng.randint(0, 256, (40, 40, 3), np.uint8))],
+        "image/class/label": [i + 1]}) for i in range(8)]
+    write_tfrecords(os.path.join(tmp_path, "train-00000-of-00001"), recs)
+    it = imagenet_iterator(str(tmp_path), batch_size=4, mode="train",
+                           image_size=32, num_decode_threads=1,
+                           shuffle_buffer=2, use_native=True)
+    b = next(it)
+    assert b["images"].shape == (4, 32, 32, 3)
+    assert (b["labels"] >= 1).all()
