@@ -54,6 +54,7 @@ class CheckpointManager:
         self.save_every_steps = save_every_steps
         self.save_every_secs = save_every_secs
         self._last_save_time = time.monotonic()
+        self._last_save_step = 0
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
@@ -62,7 +63,11 @@ class CheckpointManager:
 
     # -- policy ------------------------------------------------------------
     def should_save(self, step: int) -> bool:
-        if self.save_every_steps and step % self.save_every_steps == 0:
+        # boundary-crossing (not modulo): fused loops only surface loop-end
+        # steps, which need not be multiples of the cadence
+        if self.save_every_steps and \
+                step // self.save_every_steps > \
+                self._last_save_step // self.save_every_steps:
             return True
         if self.save_every_secs and \
                 time.monotonic() - self._last_save_time >= self.save_every_secs:
@@ -82,6 +87,7 @@ class CheckpointManager:
         self._mngr.save(step, args=ocp.args.StandardSave(_saveable(state)),
                         force=force)
         self._last_save_time = time.monotonic()
+        self._last_save_step = step
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()

@@ -28,7 +28,7 @@ from .checkpoint import CheckpointManager
 from .data import create_input_iterator
 from .evaluator import Evaluator, make_eval_iterator
 from .parallel import initialize_from_config, is_chief
-from .train.hooks import CheckpointHook, LoggingHook, SummaryHook
+from .train.hooks import CheckpointHook, LoggingHook, NanGuardHook, SummaryHook
 from .train.loop import Trainer
 from .utils.config import ExperimentConfig, parse_args, resolve_checkpoint_dir
 from .utils.metrics import MetricsWriter
@@ -54,7 +54,7 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
             start_step = int(trainer.state.step)
             log.info("resumed from checkpoint at step %d", start_step)
 
-    hooks = []
+    hooks = [NanGuardHook(every_steps=max(cfg.train.log_every_steps, 1))]
     if is_chief():
         hooks.append(LoggingHook(cfg.train.log_every_steps,
                                  batch_size=cfg.train.batch_size,

@@ -84,6 +84,28 @@ def pad_batch_to_multiple(batch: dict, multiple: int) -> dict:
     return out
 
 
+def shard_stacked_batch(batch: Any, mesh: Mesh) -> Any:
+    """Like shard_batch but for K-step stacked batches (K, B, ...): the K
+    axis is unsharded (scan iterates it), B splits over the batch axes."""
+    from .mesh import data_sharding
+    sharding = NamedSharding(mesh, P(None, *data_sharding(mesh).spec))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)
+
+
+def make_global_stacked_batch(local_batch: Any, mesh: Mesh) -> Any:
+    """Multi-process variant of shard_stacked_batch: each process holds
+    (K, B_local, ...); the global array is (K, B_local·nproc, ...)."""
+    from .mesh import data_sharding
+    sharding = NamedSharding(mesh, P(None, *data_sharding(mesh).spec))
+
+    def _make(x):
+        global_shape = (x.shape[0], x.shape[1] * jax.process_count()) + x.shape[2:]
+        return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+    return jax.tree_util.tree_map(_make, local_batch)
+
+
 def make_global_batch(local_batch: Any, mesh: Mesh) -> Any:
     """Assemble a global jax.Array from per-process local data (multi-host)."""
     from .mesh import data_sharding

@@ -23,6 +23,14 @@ from ..utils.metrics import MetricsWriter, Throughput
 log = logging.getLogger(__name__)
 
 
+def cadence_crossed(step: int, every: int, last: int) -> bool:
+    """True when [last, step] crosses a multiple of ``every``. With fused
+    multi-step loops (train.steps_per_loop > 1) hooks only observe loop-end
+    steps, so plain ``step % every == 0`` would skip cadences that k does
+    not divide."""
+    return step // every > last // every
+
+
 class LoggingHook:
     """Print step/loss/precision/lr every N steps + throughput (reference
     LoggingTensorHook cadence: 20 cifar / 40 imagenet,
@@ -33,10 +41,12 @@ class LoggingHook:
         self.every_steps = max(1, every_steps)
         self.throughput = Throughput(batch_size)
         self.print_fn = print_fn or (lambda s: log.info("%s", s))
+        self._last = 0
 
     def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
-        if step % self.every_steps != 0:
+        if not cadence_crossed(step, self.every_steps, self._last):
             return
+        self._last = step
         tp = self.throughput.update(step)
         parts = [f"step {step}"]
         for k in ("loss", "cross_entropy", "precision", "learning_rate"):
@@ -56,10 +66,12 @@ class SummaryHook:
     def __init__(self, writer: MetricsWriter, every_steps: int = 100):
         self.writer = writer
         self.every_steps = max(1, every_steps)
+        self._last = 0
 
     def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
-        if step % self.every_steps != 0:
+        if not cadence_crossed(step, self.every_steps, self._last):
             return
+        self._last = step
         scalars = {k: float(v) for k, v in metrics.items()
                    if hasattr(v, "__float__") or isinstance(v, (int, float))}
         self.writer.write_scalars(step, scalars)
@@ -73,3 +85,32 @@ class CheckpointHook:
 
     def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
         self.manager.maybe_save(step, state)
+
+
+class NanGuardHook:
+    """Abort (or callback) on non-finite loss — active divergence detection.
+
+    The reference's only guard was a human watching the 20-step loss log
+    (SURVEY.md §4.4); a NaN there kept burning cluster hours until someone
+    looked. Checks at a cadence to avoid forcing a device sync every step.
+    """
+
+    class NanLossError(RuntimeError):
+        pass
+
+    def __init__(self, every_steps: int = 100, on_nan=None):
+        self.every_steps = max(1, every_steps)
+        self.on_nan = on_nan
+        self._last = 0
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        loss = float(metrics.get("loss", 0.0))
+        if loss != loss or loss in (float("inf"), float("-inf")):
+            if self.on_nan is not None:
+                self.on_nan(step, metrics)
+                return
+            raise self.NanLossError(
+                f"non-finite loss {loss} at step {step}")

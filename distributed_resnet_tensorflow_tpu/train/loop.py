@@ -173,14 +173,21 @@ class Trainer:
             cfg.train.grad_accum_steps)
         self._eval_step = make_eval_step()
         self._jitted_train = None
+        self._jitted_multi = None
         self._jitted_eval = None
         self.state: Optional[TrainState] = None
         # single-process: device_put the full batch sharded; multi-process:
         # every process contributes its local shard of the global array
         if jax.process_count() > 1:
+            from ..parallel.sharding import make_global_stacked_batch
             self._put_batch = lambda b: make_global_batch(b, self.mesh)
+            self._put_multi_batch = \
+                lambda b: make_global_stacked_batch(b, self.mesh)
         else:
+            from ..parallel.sharding import shard_stacked_batch
             self._put_batch = lambda b: shard_batch(b, self.mesh)
+            self._put_multi_batch = \
+                lambda b: shard_stacked_batch(b, self.mesh)
 
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:
@@ -205,6 +212,33 @@ class Trainer:
                 donate_argnums=(0,))
         return self._jitted_train
 
+    def jitted_multi_step(self, k: int = 0):
+        """Fused optimizer steps per dispatch: lax.scan over stacked batches
+        (the step count comes from the input's leading axis; ``k`` is
+        documentation only). Returns (state, metrics-of-last-step)."""
+        del k
+        if self._jitted_multi is None:
+            step = self._train_step
+
+            def multi(state, batches):
+                def body(s, batch):
+                    s, m = step(s, batch)
+                    return s, m
+                state, ms = jax.lax.scan(body, state, batches)
+                last = jax.tree_util.tree_map(lambda x: x[-1], ms)
+                return state, last
+
+            shapes = jax.eval_shape(lambda s: s, self.state)
+            st_sh = state_shardings(shapes, self.mesh)
+            b_sh = NamedSharding(
+                self.mesh, P(None, *data_sharding(self.mesh).spec))
+            self._jitted_multi = jax.jit(
+                multi,
+                in_shardings=(st_sh, {"images": b_sh, "labels": b_sh}),
+                out_shardings=(st_sh, None),
+                donate_argnums=(0,))
+        return self._jitted_multi
+
     def jitted_eval_step(self):
         if self._jitted_eval is None:
             self._jitted_eval = jax.jit(self._eval_step)
@@ -213,18 +247,50 @@ class Trainer:
     # -- loops -------------------------------------------------------------
     def train(self, data_iter: Iterator, num_steps: Optional[int] = None,
               hooks: Tuple = (), start_step: int = 0):
-        """The hot loop (reference resnet_cifar_main.py:336-337)."""
+        """The hot loop (reference resnet_cifar_main.py:336-337).
+
+        With ``train.steps_per_loop > 1``, K steps run inside one XLA
+        dispatch (lax.scan); hooks fire at loop boundaries with the last
+        step's metrics.
+        """
         if self.state is None:
             self.init_state()
-        step_fn = self.jitted_train_step()
         num_steps = num_steps or self.cfg.train.train_steps
+        k = max(1, self.cfg.train.steps_per_loop)
         metrics = None
-        for step in range(start_step, num_steps):
-            batch = next(data_iter)
-            batch = self._put_batch(batch)
-            self.state, metrics = step_fn(self.state, batch)
+        if k == 1:
+            step_fn = self.jitted_train_step()
+            for step in range(start_step, num_steps):
+                batch = self._put_batch(next(data_iter))
+                self.state, metrics = step_fn(self.state, batch)
+                for h in hooks:
+                    h(step + 1, self.state, metrics)
+            return self.state, metrics
+
+        multi_fn = self.jitted_multi_step(k)
+        step = start_step
+        import numpy as np
+        while step < num_steps:
+            kk = min(k, num_steps - step)
+            if kk < k:
+                # tail shorter than k: run unfused so only kk batches are
+                # drawn from the iterator (a fused call would need k)
+                step_fn = self.jitted_train_step()
+                for _ in range(kk):
+                    b = self._put_batch(next(data_iter))
+                    self.state, metrics = step_fn(self.state, b)
+                    step += 1
+                    for h in hooks:
+                        h(step, self.state, metrics)
+                break
+            batches = [next(data_iter) for _ in range(k)]
+            stacked = {key: np.stack([b[key] for b in batches])
+                       for key in batches[0]}
+            stacked = self._put_multi_batch(stacked)
+            self.state, metrics = multi_fn(self.state, stacked)
+            step += k
             for h in hooks:
-                h(step + 1, self.state, metrics)
+                h(step, self.state, metrics)
         return self.state, metrics
 
     def evaluate(self, data_iter: Iterator, num_batches: int) -> Dict[str, float]:

@@ -111,6 +111,10 @@ class TrainConfig:
     # gradient accumulation (for large global batches on few chips)
     grad_accum_steps: int = 1
     remat: bool = False               # jax.checkpoint the block stack
+    # fuse K optimizer steps into one XLA dispatch (lax.scan over K batches).
+    # Amortizes host dispatch — the TPU analog of TPUEstimator's
+    # iterations_per_loop. Hooks/logging fire at loop boundaries.
+    steps_per_loop: int = 1
 
 
 @dataclass

@@ -41,6 +41,25 @@ class MetricsWriter:
             except Exception:  # tensorboardX optional
                 log.info("tensorboardX unavailable; JSONL metrics only")
 
+    def write_images(self, step: int, tag: str, images) -> None:
+        """Image summaries (parity with reference cifar_input.py:114's
+        tf.summary.image of input batches). TensorBoard-only; no-op without
+        tensorboardX. Accepts uint8, or float in any range — floats are
+        min-max rescaled per image (training inputs are standardized,
+        zero-mean, so clipping to [0,1] would render garbage)."""
+        if self._tb is None:
+            return
+        import numpy as np
+        arr = np.asarray(images)
+        if arr.dtype != np.uint8:
+            arr = arr.astype(np.float32)
+            lo = arr.min(axis=(1, 2, 3), keepdims=True)
+            hi = arr.max(axis=(1, 2, 3), keepdims=True)
+            arr = ((arr - lo) / np.maximum(hi - lo, 1e-6) * 255).astype(np.uint8)
+        for i, img in enumerate(arr[:4]):
+            self._tb.add_image(f"{tag}/{i}", img, int(step),
+                               dataformats="HWC")
+
     def write_scalars(self, step: int, scalars: Dict[str, Any]) -> None:
         rec = {"step": int(step), "time": time.time()}
         for k, v in scalars.items():

@@ -1,0 +1,90 @@
+"""Profiling / tracing — the subsystem the reference left vestigial.
+
+The reference had commented-out ``tf.contrib.tfprof`` param/FLOP counting
+(reference resnet_single.py:58-66, commented at resnet_cifar_main.py:260-268)
+and measured throughput offline from log timestamps (SURVEY.md §5). Here:
+
+  * ``count_params`` / ``flops_per_step``  — live counters from the compiled
+    XLA executable (cost analysis), not estimates.
+  * ``mfu``                                — model FLOPs utilization against
+    a per-generation peak table.
+  * ``trace``                              — context manager around
+    ``jax.profiler`` emitting a TensorBoard-viewable trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+# bf16 peak TFLOP/s per chip by TPU generation (public spec-sheet numbers)
+TPU_PEAK_TFLOPS = {
+    "v2": 45.0, "v3": 123.0 / 2,          # v3 number is per-chip (2 cores)
+    "v4": 275.0, "v5e": 197.0, "v5 lite": 197.0, "v5p": 459.0, "v6e": 918.0,
+}
+
+
+def count_params(params: Any) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_step(jitted_fn, *example_args) -> Optional[float]:
+    """FLOPs of one compiled step, from XLA's own cost analysis."""
+    try:
+        compiled = jitted_fn.lower(*example_args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception as e:  # cost analysis not supported on this backend
+        log.debug("cost analysis unavailable: %s", e)
+        return None
+
+
+def detect_peak_tflops() -> Optional[float]:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for key, peak in TPU_PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def mfu(steps_per_sec: float, step_flops: float,
+        num_devices: Optional[int] = None,
+        peak_tflops: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization in [0,1]: achieved / peak."""
+    peak = peak_tflops or detect_peak_tflops()
+    if not peak or not step_flops:
+        return None
+    n = num_devices or jax.device_count()
+    return (steps_per_sec * step_flops) / (peak * 1e12 * n)
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """jax.profiler trace → TensorBoard 'profile' plugin directory."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def summarize_model(trainer, batch=None) -> Dict[str, Any]:
+    """Params + per-step FLOPs + peak for the trainer's compiled step."""
+    out: Dict[str, Any] = {
+        "params": count_params(trainer.state.params),
+        "devices": jax.device_count(),
+        "peak_tflops_per_chip": detect_peak_tflops(),
+    }
+    if batch is not None:
+        step = trainer.jitted_train_step()
+        out["flops_per_step"] = flops_per_step(step, trainer.state, batch)
+    return out

@@ -67,8 +67,11 @@ def test_restore_without_checkpoint_is_noop(tmp_path):
 def test_step_and_time_cadence(tmp_path):
     mngr = CheckpointManager(str(tmp_path / "c"), save_every_steps=10,
                              save_every_secs=0.0, async_save=False)
-    assert mngr.should_save(10) and mngr.should_save(20)
-    assert not mngr.should_save(11)
+    assert not mngr.should_save(9)    # no boundary crossed yet
+    assert mngr.should_save(10)
+    mngr._last_save_step = 10         # as save() would record
+    assert not mngr.should_save(11)   # 10-boundary already saved
+    assert mngr.should_save(20)
     # time-based (reference save_checkpoint_secs=60 semantics)
     mngr2 = CheckpointManager(str(tmp_path / "c2"), save_every_steps=0,
                               save_every_secs=0.05, async_save=False)

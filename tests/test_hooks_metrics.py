@@ -71,3 +71,55 @@ def test_checkpoint_hook_delegates(tmp_path):
     h = CheckpointHook(FakeMngr())
     h(7, "state", {})
     assert calls == [7]
+
+
+def test_nan_guard_hook():
+    import pytest
+    from distributed_resnet_tensorflow_tpu.train.hooks import NanGuardHook
+    h = NanGuardHook(every_steps=10)
+    h(10, None, {"loss": 1.0})           # fine
+    h(5, None, {"loss": float("nan")})   # off-cadence: not checked
+    with pytest.raises(NanGuardHook.NanLossError):
+        h(20, None, {"loss": float("nan")})
+    seen = []
+    h2 = NanGuardHook(every_steps=1, on_nan=lambda s, m: seen.append(s))
+    h2(3, None, {"loss": float("inf")})
+    assert seen == [3]
+
+
+def test_write_images(tmp_path):
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=True)
+    w.write_images(1, "inputs", np.random.rand(2, 8, 8, 3).astype(np.float32))
+    w.close()
+    assert any(f.startswith("events") for f in os.listdir(tmp_path))
+
+
+def test_cadence_crossing_with_fused_loops():
+    """Hooks observing only loop-end steps (k=3) must still fire when the
+    cadence (10) is crossed, even though 10 % 3 != 0."""
+    from distributed_resnet_tensorflow_tpu.train.hooks import cadence_crossed
+    fired = []
+    last = 0
+    for step in range(3, 100, 3):   # loop-end steps 3,6,9,12,...
+        if cadence_crossed(step, 10, last):
+            fired.append(step)
+            last = step
+    assert fired == [12, 21, 30, 42, 51, 60, 72, 81, 90]
+
+    lines = []
+    h = LoggingHook(every_steps=10, print_fn=lines.append)
+    for step in range(3, 31, 3):
+        h(step, None, {"loss": 1.0})
+    assert len(lines) == 3  # crossed 10, 20, 30
+
+
+def test_checkpoint_manager_crossing_cadence(tmp_path):
+    from distributed_resnet_tensorflow_tpu.checkpoint import CheckpointManager
+    m = CheckpointManager(str(tmp_path / "x"), save_every_steps=10,
+                          save_every_secs=0.0, async_save=False)
+    assert not m.should_save(3)
+    assert m.should_save(12)          # crossed 10
+    m._last_save_step = 12            # as save() would set
+    assert not m.should_save(18)
+    assert m.should_save(21)          # crossed 20
+    m.close()

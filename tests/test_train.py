@@ -144,3 +144,55 @@ def test_evaluate_with_masked_batches():
 
     out = tr.evaluate(masked(it), num_batches=2)
     assert out["count"] == 24
+
+
+def test_steps_per_loop_matches_sequential():
+    """K fused steps (lax.scan) == K sequential steps (logistic, exact)."""
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import (
+        shard_stacked_batch)
+    it = learnable_synthetic_iterator(8, 8, 4, seed=9)
+    batches = [next(it) for _ in range(4)]
+
+    def build(spl):
+        cfg = _tiny_cfg()
+        cfg.model.name = "logistic"
+        cfg.model.num_classes = 4
+        cfg.model.input_size = 8 * 8 * 3
+        cfg.train.batch_size = 8
+        cfg.train.steps_per_loop = spl
+        tr = Trainer(cfg)
+        tr.init_state(seed=0)
+        return tr
+
+    tr_seq = build(1)
+    step_fn = tr_seq.jitted_train_step()
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import shard_batch
+    for b in batches:
+        tr_seq.state, m_seq = step_fn(tr_seq.state, shard_batch(b, tr_seq.mesh))
+
+    tr_fused = build(4)
+    stacked = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+    multi = tr_fused.jitted_multi_step(4)
+    tr_fused.state, m_fused = multi(
+        tr_fused.state, shard_stacked_batch(stacked, tr_fused.mesh))
+
+    assert int(tr_seq.state.step) == int(tr_fused.state.step) == 4
+    for a, b in zip(jax.tree_util.tree_leaves(tr_seq.state.params),
+                    jax.tree_util.tree_leaves(tr_fused.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert np.isclose(float(m_seq["loss"]), float(m_fused["loss"]), rtol=1e-5)
+
+
+def test_trainer_train_with_steps_per_loop_and_tail():
+    """num_steps not a multiple of steps_per_loop: tail runs unfused."""
+    cfg = _tiny_cfg()
+    cfg.train.steps_per_loop = 3
+    tr = Trainer(cfg)
+    tr.init_state()
+    hook_steps = []
+    it = learnable_synthetic_iterator(16, 8, 4)
+    state, m = tr.train(it, num_steps=7,
+                        hooks=(lambda s, st, mm: hook_steps.append(s),))
+    assert int(state.step) == 7
+    assert hook_steps == [3, 6, 7]
