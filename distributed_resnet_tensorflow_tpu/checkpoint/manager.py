@@ -63,11 +63,11 @@ class CheckpointManager:
 
     # -- policy ------------------------------------------------------------
     def should_save(self, step: int) -> bool:
+        from ..utils import cadence_crossed
         # boundary-crossing (not modulo): fused loops only surface loop-end
         # steps, which need not be multiples of the cadence
-        if self.save_every_steps and \
-                step // self.save_every_steps > \
-                self._last_save_step // self.save_every_steps:
+        if self.save_every_steps and cadence_crossed(
+                step, self.save_every_steps, self._last_save_step):
             return True
         if self.save_every_secs and \
                 time.monotonic() - self._last_save_time >= self.save_every_secs:
@@ -109,6 +109,10 @@ class CheckpointManager:
             step=restored["step"], params=restored["params"],
             batch_stats=restored["batch_stats"],
             opt_state=restored["opt_state"])
+        # resume continues the cadence from the restored step — without this,
+        # the first maybe_save after a restart fires immediately off-cadence
+        self._last_save_step = step
+        self._last_save_time = time.monotonic()
         return new_state, step
 
     def wait_until_finished(self) -> None:

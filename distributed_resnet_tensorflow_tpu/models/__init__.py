@@ -6,3 +6,4 @@ from .resnet import (  # noqa: F401
     create_model,
 )
 from .logistic import LogisticNet  # noqa: F401
+from .transformer import VisionTransformer  # noqa: F401

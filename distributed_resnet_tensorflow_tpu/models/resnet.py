@@ -303,6 +303,14 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
         from .logistic import LogisticNet
         return LogisticNet(num_classes=model_cfg.num_classes,
                            hidden_units=model_cfg.hidden_units)
+    if model_cfg.name == "vit":
+        from .transformer import VisionTransformer
+        return VisionTransformer(
+            num_classes=model_cfg.num_classes,
+            patch_size=model_cfg.vit_patch_size,
+            dim=model_cfg.vit_dim, depth=model_cfg.vit_depth,
+            num_heads=model_cfg.vit_heads, dtype=dtype,
+            attention_impl=model_cfg.attention_impl, remat=remat)
     if dataset in ("cifar10", "cifar100", "synthetic"):
         return CifarResNetV2(
             resnet_size=model_cfg.resnet_size,

@@ -1,0 +1,115 @@
+"""Vision Transformer — the attention-based model family.
+
+The reference is conv-only; this framework treats attention and long context
+as first-class (ops/attention.py, ops/pallas/flash_attention.py). This module
+provides the trainable model that exercises those ops end-to-end through the
+same Trainer/config path as the ResNets:
+
+  * ``VisionTransformer`` — patchify → encoder stack → mean-pool → head,
+    drop-in for the classification pipeline (same (B, H, W, C) → logits
+    contract as the ResNets).
+  * ``attention_impl`` selects the kernel: "dense" (reference semantics),
+    "blockwise" (O(T) memory lax), or "flash" (Pallas TPU kernel).
+
+All linear algebra is MXU-shaped (model dims multiples of 128 recommended);
+bf16 compute / f32 params as elsewhere.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _apply_attention(q, k, v, impl: str):
+    if impl == "dense":
+        from ..ops.attention import attention
+        return attention(q, k, v)
+    if impl == "blockwise":
+        from ..ops.attention import blockwise_attention
+        return blockwise_attention(q, k, v)
+    if impl == "flash":
+        from ..ops.pallas import flash_attention
+        return flash_attention(q, k, v)
+    raise ValueError(f"unknown attention_impl {impl!r}")
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, t, d = x.shape
+        if d % self.num_heads:
+            raise ValueError(f"dim {d} not divisible by heads {self.num_heads}")
+        hd = d // self.num_heads
+        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype,
+                       name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, self.num_heads, hd)
+        k = k.reshape(b, t, self.num_heads, hd)
+        v = v.reshape(b, t, self.num_heads, hd)
+        out = _apply_attention(q, k, v, self.attention_impl)
+        out = out.reshape(b, t, d)
+        return nn.Dense(d, use_bias=False, dtype=self.dtype, name="proj")(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + MultiHeadAttention(self.num_heads, self.dtype,
+                                   self.attention_impl)(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(d, dtype=self.dtype)(h)
+        return x + h
+
+
+class VisionTransformer(nn.Module):
+    num_classes: int = 10
+    patch_size: int = 4
+    dim: int = 128
+    depth: int = 6
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        del train  # no BN; deterministic (dropout-free baseline config)
+        b, h, w, c = x.shape
+        p = self.patch_size
+        if h % p or w % p:
+            raise ValueError(f"image {h}x{w} not divisible by patch {p}")
+        x = x.astype(self.dtype)
+        # patchify: conv with stride p == linear patch embed
+        x = nn.Conv(self.dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        x = x.reshape(b, -1, self.dim)
+        t = x.shape[1]
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, t, self.dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        block = EncoderBlock
+        if self.remat:
+            block = nn.remat(block)
+        for _ in range(self.depth):
+            x = block(self.num_heads, self.mlp_ratio, self.dtype,
+                      self.attention_impl)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x.mean(axis=1).astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

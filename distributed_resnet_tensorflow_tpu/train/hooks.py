@@ -23,12 +23,7 @@ from ..utils.metrics import MetricsWriter, Throughput
 log = logging.getLogger(__name__)
 
 
-def cadence_crossed(step: int, every: int, last: int) -> bool:
-    """True when [last, step] crosses a multiple of ``every``. With fused
-    multi-step loops (train.steps_per_loop > 1) hooks only observe loop-end
-    steps, so plain ``step % every == 0`` would skip cadences that k does
-    not divide."""
-    return step // every > last // every
+from ..utils import cadence_crossed  # noqa: F401  (re-export; shared impl)
 
 
 class LoggingHook:

@@ -175,6 +175,7 @@ class Trainer:
         self._jitted_train = None
         self._jitted_multi = None
         self._jitted_eval = None
+        self._dev_prefetch = None
         self.state: Optional[TrainState] = None
         # single-process: device_put the full batch sharded; multi-process:
         # every process contributes its local shard of the global array
@@ -259,10 +260,19 @@ class Trainer:
         k = max(1, self.cfg.train.steps_per_loop)
         metrics = None
         if k == 1:
+            from ..data.device_prefetch import device_prefetch
             step_fn = self.jitted_train_step()
+            # keep one transfer in flight behind compute; the wrapped iterator
+            # is cached per data_iter so segmented training (repeated train()
+            # calls over one shared iterator, e.g. train_and_eval) doesn't
+            # drop the prefetched batches between segments
+            if self._dev_prefetch is None or self._dev_prefetch[0] is not data_iter:
+                self._dev_prefetch = (
+                    data_iter,
+                    device_prefetch(iter(data_iter), self._put_batch, depth=2))
+            dev_iter = self._dev_prefetch[1]
             for step in range(start_step, num_steps):
-                batch = self._put_batch(next(data_iter))
-                self.state, metrics = step_fn(self.state, batch)
+                self.state, metrics = step_fn(self.state, next(dev_iter))
                 for h in hooks:
                     h(step + 1, self.state, metrics)
             return self.state, metrics

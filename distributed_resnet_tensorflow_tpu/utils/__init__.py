@@ -11,3 +11,11 @@ from .config import (  # noqa: F401
     get_preset,
     parse_args,
 )
+
+
+def cadence_crossed(step: int, every: int, last: int) -> bool:
+    """True when (last, step] crosses a multiple of ``every``. Shared by
+    hooks and CheckpointManager: fused multi-step loops only surface loop-end
+    steps, so plain ``step % every == 0`` would skip cadences the loop size
+    does not divide."""
+    return step // every > last // every

@@ -22,7 +22,7 @@ class ModelConfig:
     the size/width axes the reference hard-coded (resnet_model.py:71-74 pins
     resnet_size=50 for both datasets)."""
 
-    name: str = "resnet"              # resnet | logistic
+    name: str = "resnet"              # resnet | logistic | vit
     resnet_size: int = 50             # cifar: 6n+2 ∈ {20,32,44,50,56,110,...}; imagenet: 18/34/50/101/152/200
     width_multiplier: int = 1         # Wide-ResNet (e.g. 28-10 → resnet_size=28, width=10)
     num_classes: int = 10
@@ -39,6 +39,12 @@ class ModelConfig:
     # toy MLP (reference logist_model.py:10-11)
     hidden_units: int = 100
     input_size: int = 32 * 32 * 3
+    # ViT family (attention-based; beyond-reference capability)
+    vit_patch_size: int = 4
+    vit_dim: int = 128
+    vit_depth: int = 6
+    vit_heads: int = 4
+    attention_impl: str = "dense"     # dense | blockwise | flash
 
 
 @dataclass

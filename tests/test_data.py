@@ -138,3 +138,23 @@ def test_prefetch_propagates_errors():
     import pytest
     with pytest.raises(RuntimeError):
         next(it)
+
+
+def test_device_prefetch():
+    from distributed_resnet_tensorflow_tpu.data.device_prefetch import (
+        device_prefetch)
+    puts = []
+
+    def put(x):
+        puts.append(x)
+        return x * 10
+
+    out = list(device_prefetch(iter([1, 2, 3, 4]), put, depth=2))
+    assert out == [10, 20, 30, 40]
+    # transfers dispatched ahead: when 10 was yielded, 1..3 were already put
+    assert puts == [1, 2, 3, 4]
+
+    # shorter than depth
+    assert list(device_prefetch(iter([5]), put, depth=3)) == [50]
+    # empty
+    assert list(device_prefetch(iter([]), put, depth=2)) == []

@@ -29,6 +29,7 @@ def test_two_process_spmd_train(tmp_path):
             "--set", "data.image_size=8",
             "--set", "train.batch_size=16",  # 2 procs × 8 fake devices
             "--set", "train.train_steps=6",
+            "--set", "train.steps_per_loop=2",  # covers make_global_stacked_batch
             "--set", "train.log_every_steps=2",
             "--set", f"log_root={tmp_path}",
             "--set", "checkpoint.save_every_steps=0",

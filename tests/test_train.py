@@ -196,3 +196,30 @@ def test_trainer_train_with_steps_per_loop_and_tail():
                         hooks=(lambda s, st, mm: hook_steps.append(s),))
     assert int(state.step) == 7
     assert hook_steps == [3, 6, 7]
+
+
+def test_segmented_training_does_not_skip_batches():
+    """Repeated train() calls over ONE shared iterator must consume batches
+    contiguously despite the device-prefetch lookahead."""
+    cfg = _tiny_cfg()
+    cfg.model.name = "logistic"
+    cfg.model.num_classes = 4
+    cfg.model.input_size = 8 * 8 * 3
+    tr = Trainer(cfg)
+    tr.init_state(seed=0)
+
+    consumed = []
+
+    def tracking_iter():
+        i = 0
+        it = learnable_synthetic_iterator(16, 8, 4, seed=1)
+        while True:
+            consumed.append(i)
+            i += 1
+            yield next(it)
+
+    it = tracking_iter()
+    tr.train(it, num_steps=3)
+    tr.train(it, num_steps=6, start_step=3)
+    # 9 steps total; prefetch may hold up to 2 batches in flight beyond that
+    assert len(consumed) <= 9 + 2
