@@ -35,15 +35,12 @@ def launch_local(num_processes: int, main_args: List[str],
                  devices_per_process: int = 1, port: int = 8476) -> int:
     """Spawn N copies of main.py on localhost over the loopback coordinator.
     Returns the first nonzero exit code (0 if all succeed)."""
+    from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
+        virtual_cpu_env)
+
     procs = []
     for pid in range(num_processes):
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = env.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            env["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{devices_per_process}").strip()
+        env = virtual_cpu_env(devices_per_process)
         cmd = [sys.executable, "-m", "distributed_resnet_tensorflow_tpu.main",
                *main_args,
                "--set", f"mesh.coordinator_address=127.0.0.1:{port}",

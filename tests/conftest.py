@@ -12,16 +12,16 @@ JAX_PLATFORMS env var), so we must flip it back through jax.config, before
 any backend is initialized.
 """
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (  # noqa: E402
+    apply_virtual_cpu)
+
+apply_virtual_cpu(8)  # XLA_FLAGS device count + jax.config platform flip
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

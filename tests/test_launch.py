@@ -21,6 +21,7 @@ def _free_port():
 def test_two_process_spmd_train(tmp_path):
     rc = launch_local(
         num_processes=2,
+        devices_per_process=8,  # explicit: 2 procs × 8 fake devices
         main_args=[
             "--preset", "smoke",
             "--set", "model.name=logistic",
