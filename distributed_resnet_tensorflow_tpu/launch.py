@@ -32,12 +32,18 @@ from typing import List
 
 
 def launch_local(num_processes: int, main_args: List[str],
-                 devices_per_process: int = 1, port: int = 8476) -> int:
+                 devices_per_process: int = 0, port: int = 8476) -> int:
     """Spawn N copies of main.py on localhost over the loopback coordinator.
-    Returns the first nonzero exit code (0 if all succeed)."""
-    from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
-        virtual_cpu_env)
+    Returns the first nonzero exit code (0 if all succeed).
 
+    ``devices_per_process=0`` (default) honors a device count the user
+    already exported via XLA_FLAGS, falling back to 1."""
+    from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
+        existing_device_count, virtual_cpu_env)
+
+    if not devices_per_process:
+        devices_per_process = existing_device_count(
+            os.environ.get("XLA_FLAGS", "")) or 1
     procs = []
     for pid in range(num_processes):
         env = virtual_cpu_env(devices_per_process)
@@ -72,7 +78,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="local multi-process SPMD launcher")
     ap.add_argument("--num_processes", type=int, default=2)
-    ap.add_argument("--devices_per_process", type=int, default=1)
+    ap.add_argument("--devices_per_process", type=int, default=0,
+                    help="0 = inherit XLA_FLAGS device count, else 1")
     ap.add_argument("--port", type=int, default=8476)
     ap.add_argument("main_args", nargs=argparse.REMAINDER,
                     help="args after -- go to main.py")

@@ -36,6 +36,16 @@ from .utils.metrics import MetricsWriter
 log = logging.getLogger(__name__)
 
 
+def _per_process_batch(global_bs: int, nproc: int) -> int:
+    """Global batch must divide evenly across processes — a silent floor
+    would train a different effective batch than configured."""
+    if global_bs % nproc:
+        raise ValueError(
+            f"train.batch_size={global_bs} is not divisible by "
+            f"process_count={nproc}; the global batch would silently shrink")
+    return global_bs // nproc
+
+
 def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     """Build → (maybe) restore → train with hooks. Returns (state, metrics)."""
     trainer = Trainer(cfg)
@@ -68,7 +78,7 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     # input, SURVEY.md §3.2): each process reads 1/num_processes of the data
     # and contributes local_batch = global/num_processes
     nproc = jax.process_count()
-    per_process_bs = cfg.train.batch_size // nproc
+    per_process_bs = _per_process_batch(cfg.train.batch_size, nproc)
     data_iter = create_input_iterator(
         cfg, mode="train", shard_index=jax.process_index(),
         num_shards=nproc, batch_size=per_process_bs)
@@ -119,7 +129,7 @@ def run_train_and_eval(cfg: ExperimentConfig):
     nproc = jax.process_count()
     train_iter = create_input_iterator(
         cfg, mode="train", shard_index=jax.process_index(), num_shards=nproc,
-        batch_size=cfg.train.batch_size // nproc)
+        batch_size=_per_process_batch(cfg.train.batch_size, nproc))
 
     every = cfg.train.eval_every_steps or cfg.checkpoint.save_every_steps or 1000
     best = 0.0

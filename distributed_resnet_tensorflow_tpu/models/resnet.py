@@ -305,12 +305,16 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
                            hidden_units=model_cfg.hidden_units)
     if model_cfg.name == "vit":
         from .transformer import VisionTransformer
+        attn = model_cfg.attention_impl
+        if attn == "auto":
+            # TPU defaults to the Pallas flash kernel; elsewhere dense
+            attn = "flash" if jax.default_backend() == "tpu" else "dense"
         return VisionTransformer(
             num_classes=model_cfg.num_classes,
             patch_size=model_cfg.vit_patch_size,
             dim=model_cfg.vit_dim, depth=model_cfg.vit_depth,
             num_heads=model_cfg.vit_heads, dtype=dtype,
-            attention_impl=model_cfg.attention_impl, remat=remat)
+            attention_impl=attn, remat=remat)
     if dataset in ("cifar10", "cifar100", "synthetic"):
         return CifarResNetV2(
             resnet_size=model_cfg.resnet_size,

@@ -91,8 +91,12 @@ def _flash_forward(q, k, v, causal=False, interpret=False,
                    block_q=BLOCK_Q, block_k=BLOCK_K):
     b, t, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    # clamp blocks to the (padded) sequence, keeping them a multiple of the
+    # TPU sublane tile (16 covers bf16's (16,128) and f32's (8,128)) so
+    # Mosaic accepts shapes like t=196 (ViT-224/16)
+    t16 = -(-t // 16) * 16
+    block_q = min(block_q, t16)
+    block_k = min(block_k, t16)
     step = math.lcm(block_q, block_k)
     tpad = (-t) % step
     dpad = (-d) % 128

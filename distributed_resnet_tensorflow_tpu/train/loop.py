@@ -49,22 +49,71 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
     return optax.softmax_cross_entropy(logits, onehot).mean()
 
 
+def make_ce_fn(label_smoothing: float = 0.0, fused_xent: str = "off",
+               mesh: Optional[Mesh] = None) -> Callable:
+    """Resolve ``train.fused_xent`` into the batch CE function.
+
+    Modes: "auto" (Pallas kernel iff running on TPU — the default),
+    "on" (always compile the kernel), "interpret" (kernel in the Pallas
+    interpreter; CPU tests), "off" (optax). The fused kernel replaces the
+    reference's fused softmax_cross_entropy_with_logits TF op in-kind
+    (reference resnet_model.py:78-80). Label smoothing > 0 falls back to
+    optax (the kernel computes plain NLL).
+
+    When the mesh splits the batch over >1 shards, the kernel runs under
+    ``shard_map`` so each device computes its local (b/n, C) tile — a plain
+    ``jit`` would have to replicate the custom call (all-gathering logits).
+    """
+    if fused_xent not in ("auto", "on", "interpret", "off"):
+        raise ValueError(f"unknown fused_xent mode {fused_xent!r}")
+    mode = fused_xent
+    if mode == "auto":
+        mode = "on" if jax.default_backend() == "tpu" else "off"
+    if mode == "off" or label_smoothing > 0:
+        return lambda logits, labels: cross_entropy_loss(
+            logits, labels, label_smoothing)
+    interpret = mode == "interpret"
+    from ..ops.pallas import softmax_xent
+
+    def per_example(logits, labels):
+        return softmax_xent(logits.astype(jnp.float32), labels, interpret)
+
+    if mesh is not None and \
+            mesh.shape["data"] * mesh.shape["fsdp"] > 1:
+        from jax.experimental.shard_map import shard_map
+        batch_spec = P(("data", "fsdp"))
+        kwargs = dict(mesh=mesh,
+                      in_specs=(P(("data", "fsdp"), None), batch_spec),
+                      out_specs=batch_spec)
+        try:  # pallas_call doesn't declare varying-mesh-axes info
+            sharded = shard_map(per_example, check_vma=False, **kwargs)
+        except TypeError:  # older jax spells it check_rep
+            sharded = shard_map(per_example, check_rep=False, **kwargs)
+        return lambda logits, labels: sharded(logits, labels).mean()
+    return lambda logits, labels: per_example(logits, labels).mean()
+
+
 def make_train_step(schedule: Callable, weight_decay: float,
                     label_smoothing: float = 0.0,
                     decay_in_loss: bool = True,
-                    grad_accum_steps: int = 1):
+                    grad_accum_steps: int = 1,
+                    decay_all_params: bool = False,
+                    ce_fn: Optional[Callable] = None):
     """Build the pure train_step(state, batch) -> (state, metrics)."""
+    if ce_fn is None:
+        ce_fn = make_ce_fn(label_smoothing)
 
     def loss_fn(params, batch_stats, images, labels, apply_fn):
         variables = {"params": params, "batch_stats": batch_stats}
         logits, mutated = apply_fn(variables, images, train=True,
                                    mutable=["batch_stats"])
-        ce = cross_entropy_loss(logits, labels, label_smoothing)
+        ce = ce_fn(logits, labels)
         loss = ce
         if decay_in_loss:
-            # reference semantics: L2 over trainable kernels in the loss
-            # (reference resnet_model.py:78-86)
-            loss = loss + loss_weight_decay(params, weight_decay)
+            # L2 in the loss like the reference (resnet_model.py:78-86);
+            # decay_all_params toggles kernels-only vs all-trainables
+            loss = loss + loss_weight_decay(params, weight_decay,
+                                            decay_all_params)
         return loss, (ce, logits, mutated["batch_stats"])
 
     def single_step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
@@ -166,11 +215,20 @@ class Trainer:
                                   remat=cfg.train.remat, bn_groups=bn_groups)
         self.schedule = create_schedule(cfg.optimizer)
         decay_in_loss = cfg.optimizer.name != "lars"
+        if cfg.optimizer.decay_all_params and not decay_in_loss:
+            # LARS takes decay inside the optimizer (non-BN mask); the
+            # reference-faithful all-params L2 only exists on the loss path
+            raise ValueError(
+                "optimizer.decay_all_params is incompatible with "
+                "optimizer.name='lars' (LARS applies its own masked decay)")
         self.tx = create_optimizer(cfg.optimizer, self.schedule)
         self._train_step = make_train_step(
             self.schedule, cfg.optimizer.weight_decay,
             cfg.optimizer.label_smoothing, decay_in_loss,
-            cfg.train.grad_accum_steps)
+            cfg.train.grad_accum_steps,
+            decay_all_params=cfg.optimizer.decay_all_params,
+            ce_fn=make_ce_fn(cfg.optimizer.label_smoothing,
+                             cfg.train.fused_xent, self.mesh))
         self._eval_step = make_eval_step()
         self._jitted_train = None
         self._jitted_multi = None
@@ -310,7 +368,22 @@ class Trainer:
         n_shards = batch_shard_count(self.mesh)
         correct, count, loss_sum = 0, 0, 0.0
         for _ in range(num_batches):
-            batch = next(data_iter)
+            try:
+                batch = next(data_iter)
+            except StopIteration:
+                # one-pass streams (ImageNet eval) can exhaust before
+                # num_batches; single-process, return metrics over the
+                # batches actually consumed. Multi-process we must NOT
+                # break unilaterally — the other processes would block in
+                # the next collective — so fail loudly instead.
+                if jax.process_count() > 1:
+                    raise RuntimeError(
+                        "eval stream exhausted mid-evaluation on this "
+                        "process; with multiple processes this would "
+                        "deadlock the collective step — size "
+                        "eval_batch_count to the smallest per-process "
+                        "shard") from None
+                break
             batch = pad_batch_to_multiple(batch, n_shards)
             batch = self._put_batch(batch)
             out = step_fn(self.state, batch)

@@ -6,10 +6,12 @@ reference logist_model.py:60) and LARS for the large-batch bs=32k config
 (BASELINE.json config 5; not in the reference, which collapsed at scale —
 reference README.md:51-52).
 
-Weight decay follows the reference semantics: L2 penalty over ALL trainable
-variables added to the loss (reference resnet_model.py:78-86), so decay is
-applied in the LOSS (see loop.py), not decoupled here — except for LARS,
-which takes decay inside the optimizer per the LARS paper formulation.
+Weight decay is applied in the LOSS like the reference (resnet_model.py:78-86),
+not decoupled — except for LARS, which takes decay inside the optimizer per
+the LARS paper formulation. The decayed set differs by default: kernels-only
+(ndim>1, excluding BN γ/β and biases), with ``optimizer.decay_all_params``
+restoring the reference's all-trainables L2 for parity replays — see
+``loss_weight_decay``.
 
 There is no SyncReplicasOptimizer / DistributedOptimizer wrapper class: under
 ``jit`` over a sharded batch, the gradient all-reduce is induced by sharding
@@ -67,10 +69,16 @@ def _non_bn_mask(params):
         treedef, [keep(path, leaf) for path, leaf in flat])
 
 
-def loss_weight_decay(params, rate: float):
-    """L2 decay term added to the loss over all trainable variables —
-    the reference's formulation (resnet_model.py:78-86). Returns 0.5*rate*Σ‖w‖²
-    over conv/dense kernels (ndim>1), matching what TF's losses summed."""
+def loss_weight_decay(params, rate: float, all_params: bool = False):
+    """L2 decay term added to the loss: 0.5*rate*Σ‖w‖².
+
+    Default (``all_params=False``) decays only conv/dense kernels (ndim>1),
+    excluding BN γ/β and biases — the modern choice, and this repo's default.
+    NOTE this deliberately DIFFERS from the reference, which summed
+    ``tf.nn.l2_loss(v)`` over ALL trainable variables including BN scale/bias
+    (reference resnet_model.py:85-86). ``all_params=True``
+    (config ``optimizer.decay_all_params``) restores the reference-faithful
+    behavior for parity replays."""
     import jax
     import jax.numpy as jnp
 
@@ -78,6 +86,6 @@ def loss_weight_decay(params, rate: float):
         return 0.0
     leaves = [leaf for path, leaf in
               jax.tree_util.tree_flatten_with_path(params)[0]
-              if leaf.ndim > 1]
+              if all_params or leaf.ndim > 1]
     return 0.5 * rate * sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
                             for l in leaves)

@@ -44,7 +44,7 @@ class ModelConfig:
     vit_dim: int = 128
     vit_depth: int = 6
     vit_heads: int = 4
-    attention_impl: str = "dense"     # dense | blockwise | flash
+    attention_impl: str = "auto"      # auto (flash iff TPU) | dense | blockwise | flash
 
 
 @dataclass
@@ -74,6 +74,9 @@ class OptimizerConfig:
     momentum: float = 0.9
     learning_rate: float = 0.1
     weight_decay: float = 2e-4        # cifar train value (reference resnet_cifar_main.py:99); imagenet: 1e-4
+    # True = reference-faithful L2 over ALL trainables incl. BN scale/bias
+    # (reference resnet_model.py:85-86); False (default) = kernels only
+    decay_all_params: bool = False
     # schedule: piecewise | warmup_piecewise | cosine | constant
     schedule: str = "piecewise"
     boundaries: Tuple[int, ...] = (40000, 60000, 80000)      # reference resnet_cifar_main.py:298-307
@@ -121,6 +124,10 @@ class TrainConfig:
     # Amortizes host dispatch — the TPU analog of TPUEstimator's
     # iterations_per_loop. Hooks/logging fire at loop boundaries.
     steps_per_loop: int = 1
+    # Pallas fused softmax-xent kernel in the train loss (replaces the
+    # reference's fused TF op, resnet_model.py:78-80):
+    # auto = on iff TPU | on | interpret (CPU tests) | off
+    fused_xent: str = "auto"
 
 
 @dataclass

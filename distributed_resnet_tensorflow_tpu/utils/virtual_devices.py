@@ -29,6 +29,18 @@ def virtual_cpu_flags(n_devices: int, existing: str = "") -> str:
     return " ".join(flags)
 
 
+def existing_device_count(xla_flags: str) -> int:
+    """Device count from an existing --xla_force_host_platform_device_count
+    flag, or 0 when absent/malformed."""
+    for f in xla_flags.split():
+        if "xla_force_host_platform_device_count" in f and "=" in f:
+            try:
+                return int(f.split("=", 1)[1])
+            except ValueError:
+                return 0
+    return 0
+
+
 def virtual_cpu_env(n_devices: int,
                     base: Optional[Mapping[str, str]] = None) -> dict:
     """A copy of ``base`` (default ``os.environ``) set up for an

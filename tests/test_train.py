@@ -76,6 +76,45 @@ def test_weight_decay_in_loss():
     assert float(m["loss"]) > float(m["cross_entropy"])
 
 
+def test_loss_weight_decay_hand_computed():
+    """Both decay modes against hand-computed 0.5*rate*Σ‖w‖² values."""
+    from distributed_resnet_tensorflow_tpu.train.optimizers import (
+        loss_weight_decay)
+    params = {
+        "Dense": {"kernel": jnp.asarray([[1.0, 2.0], [3.0, 4.0]]),  # Σsq=30
+                  "bias": jnp.asarray([1.0, 1.0])},                  # Σsq=2
+        "BatchNorm": {"scale": jnp.asarray([2.0]),                   # Σsq=4
+                      "bias": jnp.asarray([3.0])},                   # Σsq=9
+    }
+    rate = 0.1
+    # kernels-only (default): just the 2-D kernel
+    assert np.isclose(float(loss_weight_decay(params, rate)), 0.5 * rate * 30)
+    # reference-faithful: ALL trainables incl. BN scale/bias and biases
+    # (reference resnet_model.py:85-86)
+    assert np.isclose(float(loss_weight_decay(params, rate, all_params=True)),
+                      0.5 * rate * (30 + 2 + 4 + 9))
+    assert loss_weight_decay(params, 0.0) == 0.0
+
+
+def test_decay_all_params_config_increases_loss():
+    """optimizer.decay_all_params=True adds BN/bias L2 on top of kernels."""
+    def run(decay_all):
+        cfg = _tiny_cfg()
+        cfg.optimizer.weight_decay = 0.01
+        cfg.optimizer.decay_all_params = decay_all
+        tr = Trainer(cfg)
+        tr.init_state(seed=0)
+        it = learnable_synthetic_iterator(16, 8, 4, seed=5)
+        _, m = tr.train(it, num_steps=1)
+        return float(m["loss"]), float(m["cross_entropy"])
+
+    loss_k, ce_k = run(False)
+    loss_a, ce_a = run(True)
+    assert np.isclose(ce_k, ce_a, rtol=1e-6)  # same init, same data
+    # BN scales init to 1.0, so all-params decay is strictly larger
+    assert loss_a > loss_k
+
+
 def test_grad_accum_matches_big_batch():
     """2 microbatches of 8 == one batch of 16 (grads averaged). Uses the
     BN-free logistic model where the equivalence is exact; with BN the
@@ -103,6 +142,40 @@ def test_grad_accum_matches_big_batch():
                                    rtol=1e-5, atol=1e-6)
     assert np.isclose(float(ma["cross_entropy"]), float(mb["cross_entropy"]),
                       rtol=1e-5)
+
+
+def test_fused_xent_train_step_matches_optax():
+    """train.fused_xent=interpret (Pallas kernel, CPU interpreter) produces
+    the same step as the optax path — including gradients, via the custom
+    VJP — on the sharded 8-device mesh (shard_map route)."""
+    def run(mode):
+        cfg = _tiny_cfg()
+        cfg.train.fused_xent = mode
+        tr = Trainer(cfg)
+        tr.init_state(seed=0)
+        it = learnable_synthetic_iterator(16, 8, 4, seed=11)
+        state, m = tr.train(it, num_steps=2)
+        return state, m
+
+    sa, ma = run("off")
+    sb, mb = run("interpret")
+    assert np.isclose(float(ma["cross_entropy"]), float(mb["cross_entropy"]),
+                      rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(sa.params),
+                    jax.tree_util.tree_leaves(sb.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_fused_xent_auto_resolves_off_cpu():
+    """auto → optax on CPU (kernel only compiles on TPU)."""
+    from distributed_resnet_tensorflow_tpu.train.loop import make_ce_fn
+    import jax.numpy as jnp
+    ce = make_ce_fn(0.0, "auto", None)
+    logits = jnp.asarray([[2.0, 0.0], [0.0, 2.0]])
+    labels = jnp.asarray([0, 1])
+    expected = float(cross_entropy_loss(logits, labels))
+    assert np.isclose(float(ce(logits, labels)), expected, rtol=1e-6)
 
 
 def test_evaluate():
