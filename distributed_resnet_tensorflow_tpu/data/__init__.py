@@ -1,5 +1,24 @@
 from .synthetic import synthetic_iterator, learnable_synthetic_iterator  # noqa: F401
 from .cifar import cifar_iterator, load_cifar, standardize, augment_train  # noqa: F401
+from .device_dataset import (  # noqa: F401
+    device_dataset_enabled, epoch_index_iterator)
+
+
+def device_augment_enabled(cfg, mode: str = "train") -> bool:
+    """Single source of truth for who augments — the iterator (yields raw
+    uint8) and the Trainer (applies ops/augment in the jitted step) MUST
+    agree, so both call this."""
+    if mode != "train" or cfg.data.dataset not in ("cifar10", "cifar100"):
+        return False
+    setting = cfg.data.device_augment
+    if setting == "on":
+        return True
+    if setting == "off":
+        return False
+    if setting != "auto":
+        raise ValueError(f"unknown device_augment setting {setting!r}")
+    import jax
+    return jax.default_backend() == "tpu"
 
 
 def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
@@ -17,7 +36,8 @@ def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
                               seed=cfg.train.seed, shard_index=shard_index,
                               num_shards=num_shards,
                               prefetch=d.prefetch_batches,
-                              use_native=d.use_native_loader)
+                              use_native=d.use_native_loader,
+                              device_augment=device_augment_enabled(cfg, mode))
     if d.dataset == "imagenet":
         from .imagenet import imagenet_iterator
         return imagenet_iterator(d.data_dir, bs, mode, image_size=d.image_size,

@@ -142,13 +142,19 @@ def augment_train(images: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
 
 def cifar_iterator(dataset: str, data_dir: str, batch_size: int, mode: str,
                    seed: int = 0, shard_index: int = 0, num_shards: int = 1,
-                   prefetch: int = 2, use_native: bool = False
+                   prefetch: int = 2, use_native: bool = False,
+                   device_augment: bool = False
                    ) -> Iterator[Dict[str, np.ndarray]]:
     """In-memory epoch iterator with full-dataset shuffle per epoch (the
     reference shuffled a 50k buffer = full epoch, resnet_cifar_main.py:221).
 
     ``shard_index/num_shards`` give each process a disjoint slice — fixing the
     reference Horovod path's unsharded input (SURVEY.md §3.2).
+
+    ``device_augment`` (train mode only): yield raw uint8 batches and leave
+    crop/flip/standardize to the jitted step (ops/augment.py) — the host
+    then only gathers records, which is what lets one CPU core feed TPU-rate
+    training.
     """
     images, labels = load_cifar(dataset, data_dir, mode, use_native=use_native)
     if num_shards > 1:
@@ -177,6 +183,11 @@ def cifar_iterator(dataset: str, data_dir: str, batch_size: int, mode: str,
                 else:
                     mask = None
                 batch_imgs = images[idx]
+                if is_train and device_augment:
+                    out = {"images": batch_imgs,  # raw uint8; device augments
+                           "labels": labels[idx].copy()}
+                    yield out
+                    continue
                 if is_train:
                     batch_imgs = augment_train(batch_imgs, rng)
                 out = {"images": standardize(batch_imgs),
@@ -196,11 +207,19 @@ def _threaded_prefetch(it: Iterator, depth: int) -> Iterator:
     tf.data prefetch (resnet_cifar_main.py:232)."""
     q: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
     sentinel = object()
+    stop = threading.Event()
 
     def worker():
         try:
             for item in it:
-                q.put(item)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if stop.is_set():
+                    return
             q.put(sentinel)
         except BaseException as e:  # propagate loader errors to the consumer
             q.put(e)
@@ -209,12 +228,15 @@ def _threaded_prefetch(it: Iterator, depth: int) -> Iterator:
     t.start()
 
     def out():
-        while True:
-            item = q.get()
-            if item is sentinel:
-                return
-            if isinstance(item, BaseException):
-                raise RuntimeError("input pipeline worker failed") from item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    return
+                if isinstance(item, BaseException):
+                    raise RuntimeError("input pipeline worker failed") from item
+                yield item
+        finally:
+            stop.set()  # closing the generator stops the worker thread
 
     return out()

@@ -10,6 +10,8 @@ exceeds transfer time.
 from __future__ import annotations
 
 import collections
+import queue as queue_mod
+import threading
 from typing import Callable, Iterator
 
 
@@ -23,14 +25,78 @@ def device_prefetch(host_iter: Iterator, put: Callable, depth: int = 2
     """
     queue: collections.deque = collections.deque()
     try:
-        for _ in range(depth):
-            queue.append(put(next(host_iter)))
-    except StopIteration:
-        pass
-    while queue:
-        out = queue.popleft()
         try:
-            queue.append(put(next(host_iter)))
+            for _ in range(depth):
+                queue.append(put(next(host_iter)))
         except StopIteration:
             pass
-        yield out
+        while queue:
+            out = queue.popleft()
+            try:
+                queue.append(put(next(host_iter)))
+            except StopIteration:
+                pass
+            yield out
+    finally:
+        # propagate close() (e.g. Trainer replacing its cached prefetcher)
+        # down to the source so worker threads shut down
+        close = getattr(host_iter, "close", None)
+        if close is not None:
+            close()
+
+
+class _WorkerError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_STOP = object()
+
+
+def threaded_stacker(host_iter: Iterator, k: int, depth: int = 2) -> Iterator:
+    """Draw K batches and np.stack them in a background thread.
+
+    This is the input side of the fused ``steps_per_loop`` dispatch
+    (Trainer.jitted_multi_step): the K-batch draw + stack is real host work
+    (decode, memcpy) that would otherwise sit between scan dispatches; a
+    bounded queue of ``depth`` pre-stacked loops keeps the dispatch thread
+    hot. Iterator exhaustion ends the stream cleanly (a trailing partial
+    group of < k batches is dropped — the Trainer runs tails unfused);
+    worker exceptions re-raise on the consuming thread. Closing the returned
+    generator stops the worker thread (it would otherwise park on the
+    bounded queue forever, holding stacked batches).
+    """
+    import numpy as np
+
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def worker():
+        try:
+            while not stop.is_set():
+                batches = [next(host_iter) for _ in range(k)]
+                item = {key: np.stack([b[key] for b in batches])
+                        for key in batches[0]}
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except queue_mod.Full:
+                        continue
+        except StopIteration:
+            q.put(_STOP)
+        except BaseException as e:  # surface on the consumer thread
+            q.put(_WorkerError(e))
+
+    threading.Thread(target=worker, daemon=True,
+                     name="drt-batch-stacker").start()
+    try:
+        while True:
+            item = q.get()
+            if item is _STOP:
+                return
+            if isinstance(item, _WorkerError):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()

@@ -46,6 +46,29 @@ def _per_process_batch(global_bs: int, nproc: int) -> int:
     return global_bs // nproc
 
 
+def _make_train_source(cfg: ExperimentConfig, trainer: Trainer):
+    """Training data source. Device-resident dataset (host ships indices,
+    data/device_dataset.py) when enabled; otherwise the streamed per-process
+    input shard (fixes the reference Horovod path's unsharded input,
+    SURVEY.md §3.2)."""
+    from .data import device_dataset_enabled
+    if device_dataset_enabled(cfg, "train"):
+        from .data import load_cifar
+        from .data.device_dataset import epoch_index_iterator
+        images, labels = load_cifar(
+            cfg.data.dataset, cfg.data.data_dir, "train",
+            use_native=cfg.data.use_native_loader)
+        trainer.attach_device_dataset(images, labels)
+        log.info("device-resident dataset: %d examples in HBM", len(labels))
+        return epoch_index_iterator(len(labels), cfg.train.batch_size,
+                                    cfg.train.seed)
+    nproc = jax.process_count()
+    return create_input_iterator(
+        cfg, mode="train", shard_index=jax.process_index(),
+        num_shards=nproc,
+        batch_size=_per_process_batch(cfg.train.batch_size, nproc))
+
+
 def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     """Build → (maybe) restore → train with hooks. Returns (state, metrics)."""
     trainer = Trainer(cfg)
@@ -74,14 +97,7 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
-    # per-process input shard (fixes the reference Horovod path's unsharded
-    # input, SURVEY.md §3.2): each process reads 1/num_processes of the data
-    # and contributes local_batch = global/num_processes
-    nproc = jax.process_count()
-    per_process_bs = _per_process_batch(cfg.train.batch_size, nproc)
-    data_iter = create_input_iterator(
-        cfg, mode="train", shard_index=jax.process_index(),
-        num_shards=nproc, batch_size=per_process_bs)
+    data_iter = _make_train_source(cfg, trainer)
 
     num_steps = max_steps if max_steps is not None else cfg.train.train_steps
     state, metrics = trainer.train(data_iter, num_steps=num_steps,
@@ -126,10 +142,7 @@ def run_train_and_eval(cfg: ExperimentConfig):
         if writer:
             hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
 
-    nproc = jax.process_count()
-    train_iter = create_input_iterator(
-        cfg, mode="train", shard_index=jax.process_index(), num_shards=nproc,
-        batch_size=_per_process_batch(cfg.train.batch_size, nproc))
+    train_iter = _make_train_source(cfg, trainer)
 
     every = cfg.train.eval_every_steps or cfg.checkpoint.save_every_steps or 1000
     best = 0.0

@@ -98,10 +98,23 @@ def make_train_step(schedule: Callable, weight_decay: float,
                     decay_in_loss: bool = True,
                     grad_accum_steps: int = 1,
                     decay_all_params: bool = False,
-                    ce_fn: Optional[Callable] = None):
-    """Build the pure train_step(state, batch) -> (state, metrics)."""
+                    ce_fn: Optional[Callable] = None,
+                    augment_fn: Optional[Callable] = None,
+                    augment_seed: int = 0):
+    """Build the pure train_step(state, batch) -> (state, metrics).
+
+    ``augment_fn(images, rng) -> images`` runs device-side augmentation at
+    the top of the step (raw uint8 in, standardized f32 out — see
+    ops/augment.py); RNG is fold_in(seed, step): deterministic and
+    resume-stable."""
     if ce_fn is None:
         ce_fn = make_ce_fn(label_smoothing)
+
+    def prep(images, step):
+        if augment_fn is None:
+            return images
+        rng = jax.random.fold_in(jax.random.PRNGKey(augment_seed), step)
+        return augment_fn(images, rng)
 
     def loss_fn(params, batch_stats, images, labels, apply_fn):
         variables = {"params": params, "batch_stats": batch_stats}
@@ -117,7 +130,7 @@ def make_train_step(schedule: Callable, weight_decay: float,
         return loss, (ce, logits, mutated["batch_stats"])
 
     def single_step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
-        images, labels = batch["images"], batch["labels"]
+        images, labels = prep(batch["images"], state.step), batch["labels"]
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (loss, (ce, logits, new_bs)), grads = grad_fn(
             state.params, state.batch_stats, images, labels, state.apply_fn)
@@ -138,7 +151,7 @@ def make_train_step(schedule: Callable, weight_decay: float,
         """lax.scan over microbatches: grads averaged, BN stats from the last
         microbatch (the reference had no accumulation; this enables reference
         global-batch parity on few chips)."""
-        images, labels = batch["images"], batch["labels"]
+        images, labels = prep(batch["images"], state.step), batch["labels"]
         n = grad_accum_steps
         mb = images.shape[0] // n
         images = images.reshape((n, mb) + images.shape[1:])
@@ -222,18 +235,25 @@ class Trainer:
                 "optimizer.decay_all_params is incompatible with "
                 "optimizer.name='lars' (LARS applies its own masked decay)")
         self.tx = create_optimizer(cfg.optimizer, self.schedule)
-        self._train_step = make_train_step(
-            self.schedule, cfg.optimizer.weight_decay,
-            cfg.optimizer.label_smoothing, decay_in_loss,
-            cfg.train.grad_accum_steps,
-            decay_all_params=cfg.optimizer.decay_all_params,
-            ce_fn=make_ce_fn(cfg.optimizer.label_smoothing,
-                             cfg.train.fused_xent, self.mesh))
+        from ..data import device_augment_enabled, device_dataset_enabled
+        aug_fn = None
+        # a device-resident dataset serves raw uint8, so it implies
+        # device-side augmentation regardless of the device_augment setting
+        if device_augment_enabled(cfg, "train") or \
+                device_dataset_enabled(cfg, "train"):
+            from ..ops.augment import cifar_train_augment
+            aug_fn = cifar_train_augment
+        self._aug_fn = aug_fn
+        self._train_step = self._build_train_step(aug_fn)
         self._eval_step = make_eval_step()
         self._jitted_train = None
         self._jitted_multi = None
         self._jitted_eval = None
         self._dev_prefetch = None
+        self._multi_prefetch = None
+        self._dev_data = None
+        self._jitted_idx = None
+        self._jitted_idx_multi = None
         self.state: Optional[TrainState] = None
         # single-process: device_put the full batch sharded; multi-process:
         # every process contributes its local shard of the global array
@@ -247,6 +267,18 @@ class Trainer:
             self._put_batch = lambda b: shard_batch(b, self.mesh)
             self._put_multi_batch = \
                 lambda b: shard_stacked_batch(b, self.mesh)
+
+    def _build_train_step(self, aug_fn):
+        cfg = self.cfg
+        return make_train_step(
+            self.schedule, cfg.optimizer.weight_decay,
+            cfg.optimizer.label_smoothing,
+            decay_in_loss=cfg.optimizer.name != "lars",
+            grad_accum_steps=cfg.train.grad_accum_steps,
+            decay_all_params=cfg.optimizer.decay_all_params,
+            ce_fn=make_ce_fn(cfg.optimizer.label_smoothing,
+                             cfg.train.fused_xent, self.mesh),
+            augment_fn=aug_fn, augment_seed=cfg.train.seed)
 
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:
@@ -303,6 +335,98 @@ class Trainer:
             self._jitted_eval = jax.jit(self._eval_step)
         return self._jitted_eval
 
+    # -- device-resident dataset (data/device_dataset.py) ------------------
+    def attach_device_dataset(self, images, labels) -> None:
+        """Upload the full dataset to HBM (replicated); train() then expects
+        an index iterator ({"idx": (bs,) int32}) and gathers batches on
+        device. Single-process only.
+
+        The dataset is raw uint8, so the step MUST augment+standardize on
+        device — if the Trainer was built without an augment_fn (e.g. config
+        resolved device_augment off on a CPU backend), rebuild the step with
+        one rather than silently training on unnormalized pixels."""
+        if jax.process_count() > 1:
+            raise ValueError("device dataset requires a single process")
+        if self._aug_fn is None:
+            from ..ops.augment import cifar_train_augment
+            self._aug_fn = cifar_train_augment
+            self._train_step = self._build_train_step(self._aug_fn)
+            self._jitted_train = None
+            self._jitted_multi = None
+        from ..parallel.mesh import replicated
+        rep = replicated(self.mesh)
+        import numpy as np
+        self._dev_data = (jax.device_put(np.asarray(images), rep),
+                          jax.device_put(np.asarray(labels), rep))
+        self._jitted_idx = None
+        self._jitted_idx_multi = None
+
+    def detach_device_dataset(self) -> None:
+        self._dev_data = None
+        self._jitted_idx = None
+        self._jitted_idx_multi = None
+
+    def _gathered_step(self):
+        step = self._train_step
+
+        def fn(state, batch, images, labels):
+            idx = batch["idx"]
+            return step(state, {"images": jnp.take(images, idx, axis=0),
+                                "labels": jnp.take(labels, idx, axis=0)})
+        return fn
+
+    def jitted_index_step(self):
+        assert self._dev_data is not None
+        if self._jitted_idx is None:
+            from ..parallel.mesh import replicated
+            shapes = jax.eval_shape(lambda s: s, self.state)
+            st_sh = state_shardings(shapes, self.mesh)
+            b_sh = data_sharding(self.mesh)
+            rep = replicated(self.mesh)
+            jit_fn = jax.jit(
+                self._gathered_step(),
+                in_shardings=(st_sh, {"idx": b_sh}, rep, rep),
+                out_shardings=(st_sh, None),
+                donate_argnums=(0,))
+            self._jitted_idx = \
+                lambda s, b: jit_fn(s, b, *self._dev_data)
+        return self._jitted_idx
+
+    def jitted_index_multi_step(self, k: int = 0):
+        del k
+        assert self._dev_data is not None
+        if self._jitted_idx_multi is None:
+            from ..parallel.mesh import replicated
+            gathered = self._gathered_step()
+
+            def multi(state, batches, images, labels):
+                def body(s, batch):
+                    return gathered(s, batch, images, labels)
+                state, ms = jax.lax.scan(body, state, batches)
+                last = jax.tree_util.tree_map(lambda x: x[-1], ms)
+                return state, last
+
+            shapes = jax.eval_shape(lambda s: s, self.state)
+            st_sh = state_shardings(shapes, self.mesh)
+            b_sh = NamedSharding(
+                self.mesh, P(None, *data_sharding(self.mesh).spec))
+            rep = replicated(self.mesh)
+            jit_fn = jax.jit(
+                multi,
+                in_shardings=(st_sh, {"idx": b_sh}, rep, rep),
+                out_shardings=(st_sh, None),
+                donate_argnums=(0,))
+            self._jitted_idx_multi = \
+                lambda s, b: jit_fn(s, b, *self._dev_data)
+        return self._jitted_idx_multi
+
+    def _put_idx(self, batch):
+        return jax.device_put(batch, {"idx": data_sharding(self.mesh)})
+
+    def _put_idx_multi(self, batch):
+        sh = NamedSharding(self.mesh, P(None, *data_sharding(self.mesh).spec))
+        return jax.device_put(batch, {"idx": sh})
+
     # -- loops -------------------------------------------------------------
     def train(self, data_iter: Iterator, num_steps: Optional[int] = None,
               hooks: Tuple = (), start_step: int = 0):
@@ -317,17 +441,25 @@ class Trainer:
         num_steps = num_steps or self.cfg.train.train_steps
         k = max(1, self.cfg.train.steps_per_loop)
         metrics = None
+        # device-resident dataset: data_iter carries {"idx"} batches; the
+        # step gathers images/labels from HBM (attach_device_dataset)
+        use_idx = self._dev_data is not None
+        put_one = self._put_idx if use_idx else self._put_batch
+        put_multi = self._put_idx_multi if use_idx else self._put_multi_batch
         if k == 1:
             from ..data.device_prefetch import device_prefetch
-            step_fn = self.jitted_train_step()
+            step_fn = self.jitted_index_step() if use_idx \
+                else self.jitted_train_step()
             # keep one transfer in flight behind compute; the wrapped iterator
             # is cached per data_iter so segmented training (repeated train()
             # calls over one shared iterator, e.g. train_and_eval) doesn't
             # drop the prefetched batches between segments
             if self._dev_prefetch is None or self._dev_prefetch[0] is not data_iter:
+                if self._dev_prefetch is not None:
+                    self._dev_prefetch[1].close()  # stop old worker threads
                 self._dev_prefetch = (
                     data_iter,
-                    device_prefetch(iter(data_iter), self._put_batch, depth=2))
+                    device_prefetch(iter(data_iter), put_one, depth=2))
             dev_iter = self._dev_prefetch[1]
             for step in range(start_step, num_steps):
                 self.state, metrics = step_fn(self.state, next(dev_iter))
@@ -335,30 +467,41 @@ class Trainer:
                     h(step + 1, self.state, metrics)
             return self.state, metrics
 
-        multi_fn = self.jitted_multi_step(k)
+        multi_fn = self.jitted_index_multi_step(k) if use_idx \
+            else self.jitted_multi_step(k)
         step = start_step
-        import numpy as np
-        while step < num_steps:
-            kk = min(k, num_steps - step)
-            if kk < k:
-                # tail shorter than k: run unfused so only kk batches are
-                # drawn from the iterator (a fused call would need k)
-                step_fn = self.jitted_train_step()
-                for _ in range(kk):
-                    b = self._put_batch(next(data_iter))
-                    self.state, metrics = step_fn(self.state, b)
-                    step += 1
-                    for h in hooks:
-                        h(step, self.state, metrics)
-                break
-            batches = [next(data_iter) for _ in range(k)]
-            stacked = {key: np.stack([b[key] for b in batches])
-                       for key in batches[0]}
-            stacked = self._put_multi_batch(stacked)
-            self.state, metrics = multi_fn(self.state, stacked)
+        # K-batch draw + stack runs on a background thread; device_prefetch
+        # keeps one stacked transfer in flight behind the scan dispatch, so
+        # the dispatch thread never waits on host-side input prep. Cached per
+        # data_iter (like the K=1 path) so segmented training keeps its queue.
+        if self._multi_prefetch is None or self._multi_prefetch[0] is not data_iter:
+            from ..data.device_prefetch import device_prefetch, threaded_stacker
+            if self._multi_prefetch is not None:
+                self._multi_prefetch[1].close()  # stop old worker threads
+            self._multi_prefetch = (
+                data_iter,
+                device_prefetch(threaded_stacker(iter(data_iter), k),
+                                put_multi, depth=2))
+        stacked_iter = self._multi_prefetch[1]
+        while step + k <= num_steps:
+            self.state, metrics = multi_fn(self.state, next(stacked_iter))
             step += k
             for h in hooks:
                 h(step, self.state, metrics)
+        if step < num_steps:
+            # tail shorter than k: run unfused, consuming the FIRST elements
+            # of one more pre-stacked group. Never touch data_iter directly
+            # here — the stacker's worker thread iterates it concurrently and
+            # generators are not thread-safe.
+            step_fn = self.jitted_index_step() if use_idx \
+                else self.jitted_train_step()
+            stacked = next(stacked_iter)
+            for i in range(num_steps - step):
+                b = jax.tree_util.tree_map(lambda x: x[i], stacked)
+                self.state, metrics = step_fn(self.state, b)
+                step += 1
+                for h in hooks:
+                    h(step, self.state, metrics)
         return self.state, metrics
 
     def evaluate(self, data_iter: Iterator, num_batches: int) -> Dict[str, float]:

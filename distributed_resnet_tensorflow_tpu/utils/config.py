@@ -59,6 +59,13 @@ class DataConfig:
     prefetch_batches: int = 2         # reference prefetches 2*bs samples (resnet_cifar_main.py:232)
     num_parallel_calls: int = 8
     use_native_loader: bool = False   # C++ threaded loader (native/)
+    # crop/flip/standardize inside the jitted step (ops/augment.py) instead
+    # of on the host — auto = on iff TPU. Train-time CIFAR only.
+    device_augment: str = "auto"      # auto | on | off
+    # whole dataset resident in HBM, batches gathered on device, host ships
+    # only indices (data/device_dataset.py) — auto = on iff TPU,
+    # single-process, CIFAR-scale. Implies device_augment.
+    device_dataset: str = "auto"      # auto | on | off
     # eval pipeline
     eval_batch_size: int = 100        # reference resnet_cifar_eval.py batch of 100
 

@@ -1,0 +1,196 @@
+"""Device-side augmentation tests (ops/augment.py) — semantics parity with
+the host numpy pipeline (data/cifar.py) and the raw-uint8 train-step path."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_resnet_tensorflow_tpu.data import cifar_iterator, standardize
+from distributed_resnet_tensorflow_tpu.ops import augment
+
+
+def test_standardize_matches_host():
+    """Device standardize == host standardize (same TF adjusted-std math)."""
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (4, 32, 32, 3)).astype(np.uint8)
+    host = standardize(imgs)
+    dev = np.asarray(augment.standardize(jnp.asarray(imgs)))
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-5)
+
+
+def test_standardize_low_variance_uses_adjusted_std():
+    """Constant image: std=0 → divide by 1/sqrt(N), not by zero."""
+    imgs = np.full((1, 32, 32, 3), 7, np.uint8)
+    out = np.asarray(augment.standardize(jnp.asarray(imgs)))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_random_crop_flip_outputs_are_valid_windows():
+    """Every augmented image must be a 32×32 window of the padded original,
+    possibly horizontally flipped. A per-pixel ramp makes windows unique."""
+    h = w = 32
+    base = (np.arange(h * w * 3, dtype=np.float32).reshape(h, w, 3) % 251)
+    imgs = np.stack([base] * 8)
+    out = np.asarray(augment.random_crop_flip(
+        jnp.asarray(imgs), jax.random.PRNGKey(0), pad=4))
+    assert out.shape == imgs.shape
+    padded = np.pad(imgs[0], ((4, 4), (4, 4), (0, 0)))
+    windows = {}
+    for y in range(9):
+        for x in range(9):
+            win = padded[y:y + h, x:x + w]
+            windows[win.tobytes()] = (y, x, False)
+            windows[win[:, ::-1].tobytes()] = (y, x, True)
+    for i in range(8):
+        assert out[i].tobytes() in windows, f"image {i} is not a valid crop"
+
+
+def test_random_crop_flip_varies_across_batch():
+    base = np.arange(32 * 32 * 3, dtype=np.float32).reshape(32, 32, 3)
+    imgs = np.stack([base] * 16)
+    out = np.asarray(augment.random_crop_flip(
+        jnp.asarray(imgs), jax.random.PRNGKey(1)))
+    # with 162 possible (crop, flip) outcomes, 16 identical draws ~ impossible
+    assert len({out[i].tobytes() for i in range(16)}) > 1
+
+
+def test_cifar_train_augment_deterministic_in_key():
+    rng = np.random.RandomState(2)
+    imgs = jnp.asarray(rng.randint(0, 256, (4, 32, 32, 3)).astype(np.uint8))
+    a = augment.cifar_train_augment(imgs, jax.random.PRNGKey(5))
+    b = augment.cifar_train_augment(imgs, jax.random.PRNGKey(5))
+    c = augment.cifar_train_augment(imgs, jax.random.PRNGKey(6))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert a.dtype == jnp.float32
+
+
+def _write_fake_cifar10(tmp_path, n_per_file=20):
+    rng = np.random.RandomState(0)
+    for name in [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]:
+        recs = np.zeros((n_per_file, 1 + 3072), np.uint8)
+        recs[:, 0] = rng.randint(0, 10, n_per_file)
+        recs[:, 1:] = rng.randint(0, 256, (n_per_file, 3072))
+        recs.tofile(os.path.join(tmp_path, name))
+    return str(tmp_path)
+
+
+def test_raw_iterator_and_device_augment_train_step(tmp_path):
+    """End-to-end: device_augment=on makes the iterator yield raw uint8 and
+    the Trainer augment + standardize inside the jitted step."""
+    from distributed_resnet_tensorflow_tpu.data import (
+        create_input_iterator, device_augment_enabled)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    d = _write_fake_cifar10(tmp_path)
+    cfg = get_preset("smoke")
+    cfg.model.compute_dtype = "float32"
+    cfg.model.resnet_size = 8
+    cfg.data.dataset = "cifar10"
+    cfg.data.data_dir = d
+    cfg.data.device_augment = "on"
+    cfg.data.prefetch_batches = 0
+    cfg.train.batch_size = 16
+    assert device_augment_enabled(cfg, "train")
+    assert not device_augment_enabled(cfg, "eval")
+
+    it = create_input_iterator(cfg, mode="train")
+    batch = next(it)
+    assert batch["images"].dtype == np.uint8  # host did NOT standardize
+
+    tr = Trainer(cfg)
+    tr.init_state()
+    state, m = tr.train(it, num_steps=2)
+    assert int(state.step) == 2
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_device_dataset_matches_streamed_path(tmp_path):
+    """HBM-resident dataset + index batches == streamed raw-uint8 batches:
+    same permutation (same seed), same device augmentation (rng is
+    step-keyed), so parameter trajectories must be identical. Covers both
+    the K=1 index step and the fused index scan."""
+    import jax
+    from distributed_resnet_tensorflow_tpu.data import (
+        create_input_iterator, epoch_index_iterator, load_cifar)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    d = _write_fake_cifar10(tmp_path)
+
+    def base_cfg():
+        cfg = get_preset("smoke")
+        cfg.model.compute_dtype = "float32"
+        cfg.model.resnet_size = 8
+        cfg.data.dataset = "cifar10"
+        cfg.data.data_dir = d
+        cfg.data.prefetch_batches = 0
+        cfg.train.batch_size = 16
+        cfg.train.seed = 7
+        return cfg
+
+    # A: streamed raw uint8 batches, host shuffles, device augments
+    cfg_a = base_cfg()
+    cfg_a.data.device_augment = "on"
+    cfg_a.data.device_dataset = "off"
+    tr_a = Trainer(cfg_a)
+    tr_a.init_state(seed=0)
+    tr_a.train(create_input_iterator(cfg_a, mode="train"), num_steps=6)
+
+    # B: dataset in (virtual) HBM, index batches — must be EXACTLY the same
+    # trajectory (same permutation, same step-keyed augment rng)
+    cfg_b = base_cfg()
+    cfg_b.data.device_dataset = "on"
+    tr_b = Trainer(cfg_b)
+    tr_b.init_state(seed=0)
+    images, labels = load_cifar("cifar10", d, "train")
+    tr_b.attach_device_dataset(images, labels)
+    it = epoch_index_iterator(len(labels), 16, seed=7)
+    tr_b.train(it, num_steps=6)
+
+    assert int(tr_a.state.step) == int(tr_b.state.step) == 6
+    for a, b in zip(jax.tree_util.tree_leaves(tr_a.state.params),
+                    jax.tree_util.tree_leaves(tr_b.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # fused index scan (k=3) + unfused tail: runs, advances, stays finite
+    # (scan-vs-single numeric equivalence is covered exactly by
+    # test_train.test_steps_per_loop_matches_sequential on the BN-free model;
+    # with BN the compiled-program difference legitimately perturbs bits)
+    cfg_c = base_cfg()
+    cfg_c.data.device_dataset = "on"
+    cfg_c.train.steps_per_loop = 3
+    tr_c = Trainer(cfg_c)
+    tr_c.init_state(seed=0)
+    tr_c.attach_device_dataset(images, labels)
+    state, m = tr_c.train(epoch_index_iterator(len(labels), 16, seed=7),
+                          num_steps=7)
+    assert int(state.step) == 7
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_epoch_index_iterator_covers_epoch_without_repeats():
+    from distributed_resnet_tensorflow_tpu.data import epoch_index_iterator
+    it = epoch_index_iterator(50, 16, seed=0)
+    first_epoch = [next(it)["idx"] for _ in range(3)]  # 48 of 50, partial dropped
+    flat = np.concatenate(first_epoch)
+    assert len(set(flat.tolist())) == 48  # no repeats within the epoch
+    assert all(b.dtype == np.int32 and b.shape == (16,) for b in first_epoch)
+
+
+def test_device_augment_off_yields_float(tmp_path):
+    from distributed_resnet_tensorflow_tpu.data import create_input_iterator
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    d = _write_fake_cifar10(tmp_path)
+    cfg = get_preset("smoke")
+    cfg.data.dataset = "cifar10"
+    cfg.data.data_dir = d
+    cfg.data.device_augment = "off"
+    cfg.data.prefetch_batches = 0
+    cfg.train.batch_size = 16
+    batch = next(create_input_iterator(cfg, mode="train"))
+    assert batch["images"].dtype == np.float32  # host standardized

@@ -271,6 +271,32 @@ def test_trainer_train_with_steps_per_loop_and_tail():
     assert hook_steps == [3, 6, 7]
 
 
+def test_threaded_stacker_close_stops_worker():
+    """Closing the stacker generator must terminate its worker thread
+    (otherwise every replaced prefetcher leaks a parked thread + batches)."""
+    import threading
+    import time as _time
+    from distributed_resnet_tensorflow_tpu.data.device_prefetch import (
+        threaded_stacker)
+
+    def gen():
+        i = 0
+        while True:
+            yield {"x": np.full((2,), i)}
+            i += 1
+
+    existing = set(threading.enumerate())
+    it = threaded_stacker(gen(), 3, depth=1)
+    first = next(it)
+    assert first["x"].shape == (3, 2)
+    workers = [t for t in threading.enumerate()
+               if t not in existing and "stacker" in t.name]
+    assert len(workers) == 1
+    it.close()
+    workers[0].join(3)
+    assert not workers[0].is_alive()
+
+
 def test_segmented_training_does_not_skip_batches():
     """Repeated train() calls over ONE shared iterator must consume batches
     contiguously despite the device-prefetch lookahead."""
