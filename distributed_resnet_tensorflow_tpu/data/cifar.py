@@ -22,8 +22,6 @@ cifar_input.py:66-75). Eval: standardization only.
 from __future__ import annotations
 
 import os
-import threading
-import queue as queue_mod
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -204,39 +202,7 @@ def cifar_iterator(dataset: str, data_dir: str, batch_size: int, mode: str,
 def _threaded_prefetch(it: Iterator, depth: int) -> Iterator:
     """Background-thread prefetch — host-side successor of the reference's
     16-thread RandomShuffleQueue (reference cifar_input.py:77-96) and
-    tf.data prefetch (resnet_cifar_main.py:232)."""
-    q: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
-    sentinel = object()
-    stop = threading.Event()
-
-    def worker():
-        try:
-            for item in it:
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.2)
-                        break
-                    except queue_mod.Full:
-                        continue
-                if stop.is_set():
-                    return
-            q.put(sentinel)
-        except BaseException as e:  # propagate loader errors to the consumer
-            q.put(e)
-
-    t = threading.Thread(target=worker, daemon=True)
-    t.start()
-
-    def out():
-        try:
-            while True:
-                item = q.get()
-                if item is sentinel:
-                    return
-                if isinstance(item, BaseException):
-                    raise RuntimeError("input pipeline worker failed") from item
-                yield item
-        finally:
-            stop.set()  # closing the generator stops the worker thread
-
-    return out()
+    tf.data prefetch (resnet_cifar_main.py:232). One shared implementation
+    (device_prefetch.threaded_iterator) covers worker/stop/error handling."""
+    from .device_prefetch import threaded_iterator
+    return threaded_iterator(it, depth, name="drt-cifar-prefetch")

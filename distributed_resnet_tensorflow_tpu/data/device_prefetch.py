@@ -1,11 +1,20 @@
-"""Device prefetch — overlap host→device transfer with device compute.
+"""Device prefetch + background input threads.
 
-The reference's analog was tf.data's prefetch-to-device buffering
-(prefetch(2*bs), reference resnet_cifar_main.py:232). Here: wrap a host batch
-iterator so batch i+1's ``device_put`` is dispatched while the jitted step for
-batch i is still running — JAX transfers are asynchronous, so keeping one
-batch in flight hides the PCIe/DCN copy entirely when compute per step
-exceeds transfer time.
+The reference's analog was tf.data's prefetch buffering and the 16-thread
+queue runners (reference resnet_cifar_main.py:232, cifar_input.py:77-96).
+Here:
+
+  * ``device_prefetch``   — keep ``depth`` host→device transfers in flight
+    behind compute (JAX transfers are asynchronous).
+  * ``threaded_iterator`` — run ANY iterator on a background thread with a
+    bounded queue; the single implementation of the worker/stop/error
+    machinery used by every threaded input stage.
+  * ``threaded_stacker``  — draw K batches + np.stack on a background thread
+    (the input side of the fused ``steps_per_loop`` dispatch).
+
+All returned generators stop their worker thread when closed — a replaced
+or abandoned pipeline must not leave a thread parked on its queue holding
+batches.
 """
 from __future__ import annotations
 
@@ -53,43 +62,37 @@ class _WorkerError:
 _STOP = object()
 
 
-def threaded_stacker(host_iter: Iterator, k: int, depth: int = 2) -> Iterator:
-    """Draw K batches and np.stack them in a background thread.
+def threaded_iterator(src: Iterator, depth: int = 2,
+                      name: str = "drt-input-worker") -> Iterator:
+    """Run ``src`` on a daemon thread feeding a bounded queue of ``depth``.
 
-    This is the input side of the fused ``steps_per_loop`` dispatch
-    (Trainer.jitted_multi_step): the K-batch draw + stack is real host work
-    (decode, memcpy) that would otherwise sit between scan dispatches; a
-    bounded queue of ``depth`` pre-stacked loops keeps the dispatch thread
-    hot. Iterator exhaustion ends the stream cleanly (a trailing partial
-    group of < k batches is dropped — the Trainer runs tails unfused);
-    worker exceptions re-raise on the consuming thread. Closing the returned
-    generator stops the worker thread (it would otherwise park on the
-    bounded queue forever, holding stacked batches).
+    Worker exceptions re-raise on the consuming thread; closing the returned
+    generator (or GC'ing it) sets a stop event that EVERY queue put honors —
+    including the terminal sentinel/error puts — so the thread can never
+    park forever on a full queue.
     """
-    import numpy as np
-
     q: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
     stop = threading.Event()
 
+    def put_checked(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
     def worker():
         try:
-            while not stop.is_set():
-                batches = [next(host_iter) for _ in range(k)]
-                item = {key: np.stack([b[key] for b in batches])
-                        for key in batches[0]}
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.2)
-                        break
-                    except queue_mod.Full:
-                        continue
-        except StopIteration:
-            q.put(_STOP)
+            for item in src:
+                if not put_checked(item):
+                    return
+            put_checked(_STOP)
         except BaseException as e:  # surface on the consumer thread
-            q.put(_WorkerError(e))
+            put_checked(_WorkerError(e))
 
-    threading.Thread(target=worker, daemon=True,
-                     name="drt-batch-stacker").start()
+    threading.Thread(target=worker, daemon=True, name=name).start()
     try:
         while True:
             item = q.get()
@@ -100,3 +103,31 @@ def threaded_stacker(host_iter: Iterator, k: int, depth: int = 2) -> Iterator:
             yield item
     finally:
         stop.set()
+        close = getattr(src, "close", None)
+        if close is not None:
+            close()
+
+
+def threaded_stacker(host_iter: Iterator, k: int, depth: int = 2) -> Iterator:
+    """Draw K batches and np.stack them in a background thread.
+
+    This is the input side of the fused ``steps_per_loop`` dispatch
+    (Trainer.jitted_multi_step): the K-batch draw + stack is real host work
+    (decode, memcpy) that would otherwise sit between scan dispatches; a
+    bounded queue of ``depth`` pre-stacked loops keeps the dispatch thread
+    hot. Iterator exhaustion ends the stream cleanly (a trailing partial
+    group of < k batches is dropped); closing the returned generator stops
+    the worker thread.
+    """
+    import numpy as np
+
+    def groups():
+        while True:
+            try:
+                batches = [next(host_iter) for _ in range(k)]
+            except StopIteration:
+                return
+            yield {key: np.stack([b[key] for b in batches])
+                   for key in batches[0]}
+
+    return threaded_iterator(groups(), depth, name="drt-batch-stacker")

@@ -244,6 +244,7 @@ class Trainer:
             from ..ops.augment import cifar_train_augment
             aug_fn = cifar_train_augment
         self._aug_fn = aug_fn
+        self._cfg_aug_fn = aug_fn  # the config-resolved choice, for detach
         self._train_step = self._build_train_step(aug_fn)
         self._eval_step = make_eval_step()
         self._jitted_train = None
@@ -362,9 +363,18 @@ class Trainer:
         self._jitted_idx_multi = None
 
     def detach_device_dataset(self) -> None:
+        """Drop the HBM dataset and restore the config-resolved augment
+        choice (attach may have forced device-side augmentation; a streamed
+        iterator on a non-TPU backend standardizes on the host, and keeping
+        the forced augment would double-augment)."""
         self._dev_data = None
         self._jitted_idx = None
         self._jitted_idx_multi = None
+        if self._aug_fn is not self._cfg_aug_fn:
+            self._aug_fn = self._cfg_aug_fn
+            self._train_step = self._build_train_step(self._aug_fn)
+            self._jitted_train = None
+            self._jitted_multi = None
 
     def _gathered_step(self):
         step = self._train_step
@@ -473,35 +483,67 @@ class Trainer:
         # K-batch draw + stack runs on a background thread; device_prefetch
         # keeps one stacked transfer in flight behind the scan dispatch, so
         # the dispatch thread never waits on host-side input prep. Cached per
-        # data_iter (like the K=1 path) so segmented training keeps its queue.
+        # data_iter (like the K=1 path) so segmented training keeps its
+        # queue; entry[2] carries a [stacked_group, offset] remainder left by
+        # a previous segment's tail so no drawn batch is ever discarded.
         if self._multi_prefetch is None or self._multi_prefetch[0] is not data_iter:
             from ..data.device_prefetch import device_prefetch, threaded_stacker
             if self._multi_prefetch is not None:
                 self._multi_prefetch[1].close()  # stop old worker threads
-            self._multi_prefetch = (
+            self._multi_prefetch = [
                 data_iter,
                 device_prefetch(threaded_stacker(iter(data_iter), k),
-                                put_multi, depth=2))
-        stacked_iter = self._multi_prefetch[1]
-        while step + k <= num_steps:
-            self.state, metrics = multi_fn(self.state, next(stacked_iter))
-            step += k
-            for h in hooks:
-                h(step, self.state, metrics)
-        if step < num_steps:
-            # tail shorter than k: run unfused, consuming the FIRST elements
-            # of one more pre-stacked group. Never touch data_iter directly
-            # here — the stacker's worker thread iterates it concurrently and
-            # generators are not thread-safe.
-            step_fn = self.jitted_index_step() if use_idx \
+                                put_multi, depth=2),
+                None]
+        entry = self._multi_prefetch
+        stacked_iter = entry[1]
+
+        def single_fn():
+            return self.jitted_index_step() if use_idx \
                 else self.jitted_train_step()
-            stacked = next(stacked_iter)
-            for i in range(num_steps - step):
-                b = jax.tree_util.tree_map(lambda x: x[i], stacked)
+
+        def run_singles(stacked, offset, count):
+            nonlocal step, metrics
+            step_fn = single_fn()
+            for i in range(offset, offset + count):
+                b = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
                 self.state, metrics = step_fn(self.state, b)
                 step += 1
                 for h in hooks:
                     h(step, self.state, metrics)
+
+        # 1) consume a previous tail's remainder, one step at a time
+        if entry[2] is not None and step < num_steps:
+            stacked, offset = entry[2]
+            take = min(k - offset, num_steps - step)
+            run_singles(stacked, offset, take)
+            offset += take
+            entry[2] = None if offset >= k else [stacked, offset]
+        # 2) fused full groups. A finite stream that exhausts ends training
+        # early — the reference's serial path had the same stop condition
+        # (input exhaustion, SURVEY.md §3.5); train streams here repeat
+        # forever, so this only triggers for deliberately truncated inputs.
+        while step + k <= num_steps:
+            try:
+                stacked = next(stacked_iter)
+            except StopIteration:
+                return self.state, metrics
+            self.state, metrics = multi_fn(self.state, stacked)
+            step += k
+            for h in hooks:
+                h(step, self.state, metrics)
+        # 3) tail shorter than k: draw one more group, run the first
+        # (num_steps - step) unfused, bank the remainder for the next
+        # segment. Never touch data_iter directly — the stacker's worker
+        # thread iterates it concurrently.
+        if step < num_steps:
+            try:
+                stacked = next(stacked_iter)
+            except StopIteration:
+                return self.state, metrics
+            take = num_steps - step
+            run_singles(stacked, 0, take)
+            entry[2] = [stacked, take]
         return self.state, metrics
 
     def evaluate(self, data_iter: Iterator, num_batches: int) -> Dict[str, float]:

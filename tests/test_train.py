@@ -271,6 +271,67 @@ def test_trainer_train_with_steps_per_loop_and_tail():
     assert hook_steps == [3, 6, 7]
 
 
+def test_segmented_tail_remainder_no_skip():
+    """Segmented training with a fused-loop tail must not discard the
+    remainder of the pre-stacked group at the segment boundary: a k=3 run
+    split 4+4 must see the same batch sequence as an unfused 8-step run
+    (exact on the BN-free model)."""
+    def build(spl):
+        cfg = _tiny_cfg()
+        cfg.model.name = "logistic"
+        cfg.model.num_classes = 4
+        cfg.model.input_size = 8 * 8 * 3
+        cfg.train.steps_per_loop = spl
+        tr = Trainer(cfg)
+        tr.init_state(seed=0)
+        return tr
+
+    tr_a = build(1)
+    tr_a.train(learnable_synthetic_iterator(16, 8, 4, seed=21), num_steps=8)
+
+    tr_b = build(3)
+    it = learnable_synthetic_iterator(16, 8, 4, seed=21)
+    tr_b.train(it, num_steps=4)                  # fused 3 + tail 1 (banks 2)
+    tr_b.train(it, num_steps=8, start_step=4)    # remainder 2 + fused 3 - ...
+    assert int(tr_b.state.step) == 8
+    for a, b in zip(jax.tree_util.tree_leaves(tr_a.state.params),
+                    jax.tree_util.tree_leaves(tr_b.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_finite_stream_ends_training_at_last_full_group():
+    """A deliberately truncated input ends training cleanly (the reference's
+    serial path stopped on input exhaustion too, SURVEY.md §3.5)."""
+    cfg = _tiny_cfg()
+    cfg.train.steps_per_loop = 3
+    tr = Trainer(cfg)
+    tr.init_state()
+    src = learnable_synthetic_iterator(16, 8, 4)
+    finite = iter([next(src) for _ in range(7)])
+    state, m = tr.train(finite, num_steps=100)
+    assert int(state.step) == 6  # 2 full groups; the partial 7th is dropped
+    assert m is not None and np.isfinite(float(m["loss"]))
+
+
+def test_detach_device_dataset_restores_config_augment():
+    """attach forces device-side augmentation (raw uint8 needs it); detach
+    must restore the config-resolved choice or streamed host-standardized
+    input would be augmented twice."""
+    cfg = _tiny_cfg()
+    cfg.data.dataset = "cifar10"
+    cfg.data.device_augment = "off"   # CPU: config resolves to host augment
+    tr = Trainer(cfg)
+    tr.init_state()
+    assert tr._aug_fn is None
+    imgs = np.zeros((64, 8, 8, 3), np.uint8)
+    lbls = np.zeros((64,), np.int32)
+    tr.attach_device_dataset(imgs, lbls)
+    assert tr._aug_fn is not None
+    tr.detach_device_dataset()
+    assert tr._aug_fn is None
+
+
 def test_threaded_stacker_close_stops_worker():
     """Closing the stacker generator must terminate its worker thread
     (otherwise every replaced prefetcher leaks a parked thread + batches)."""

@@ -1,0 +1,82 @@
+"""Generate a structured, learnable dataset in CIFAR-10 binary format.
+
+This environment has no network egress, so the real CIFAR-10 binaries cannot
+be fetched. This tool writes a stand-in with the exact on-disk format
+(reference resnet_cifar_main.py:137-154: data_batch_{1..5}.bin /
+test_batch.bin, records = [1 label byte][3072 CHW bytes]) whose classes ARE
+learnable — each class is a radial grating with a class-specific spatial
+frequency and RGB channel mix, under heavy pixel noise and random phase —
+so a truncated training run demonstrates the full
+files → loader → device-dataset → augment → train → eval convergence loop.
+The class signal survives the training augmentation by construction:
+horizontal flips and ±4-pixel crops barely perturb a centered radial
+pattern, and per-image standardization removes only mean/scale.
+
+Swap in the real CIFAR-10 binaries and every command runs unchanged.
+
+Usage: python tools/make_synth_cifar.py [out_dir] [--train N] [--test N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+NUM_CLASSES = 10
+
+
+def class_images(cls: int, n: int, rng: np.random.RandomState) -> np.ndarray:
+    """(n, 32, 32, 3) uint8 images for one class."""
+    yy, xx = np.mgrid[0:32, 0:32]
+    r = np.sqrt((yy - 15.5) ** 2 + (xx - 15.5) ** 2)          # (32, 32)
+    freq = 0.10 + 0.018 * (cls % 5)                            # 5 frequencies
+    # channel mixes: two mildly-separated triplets select the other factor
+    w = np.array([[1.0, 0.5, -0.2], [0.5, 1.0, 0.2]][cls // 5])
+    phase = rng.uniform(0, 2 * np.pi, size=(n, 1, 1))
+    base = np.cos(2 * np.pi * freq * r[None] + phase)          # (n, 32, 32)
+    img = (128.0 + 18.0 * base[..., None] * w[None, None, None, :]
+           + rng.normal(0, 48.0, (n, 32, 32, 3)))
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def make_split(n: int, seed: int) -> tuple[np.ndarray, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    per = n // NUM_CLASSES
+    images = np.concatenate(
+        [class_images(c, per, rng) for c in range(NUM_CLASSES)])
+    labels = np.repeat(np.arange(NUM_CLASSES), per).astype(np.uint8)
+    order = rng.permutation(len(labels))
+    return images[order], labels[order]
+
+
+def write_cifar_files(out_dir: str, images: np.ndarray, labels: np.ndarray,
+                      names: list[str]) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    shards = np.array_split(np.arange(len(labels)), len(names))
+    for name, idx in zip(names, shards):
+        recs = np.empty((len(idx), 1 + 3072), np.uint8)
+        recs[:, 0] = labels[idx]
+        # NHWC → CHW planes, the CIFAR binary layout
+        recs[:, 1:] = images[idx].transpose(0, 3, 1, 2).reshape(len(idx), -1)
+        recs.tofile(os.path.join(out_dir, name))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir", nargs="?", default="/tmp/drt_synth_cifar10")
+    ap.add_argument("--train", type=int, default=50000)
+    ap.add_argument("--test", type=int, default=10000)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    tr_im, tr_lb = make_split(args.train, args.seed)
+    te_im, te_lb = make_split(args.test, args.seed + 1)
+    write_cifar_files(args.out_dir, tr_im, tr_lb,
+                      [f"data_batch_{i}.bin" for i in range(1, 6)])
+    write_cifar_files(args.out_dir, te_im, te_lb, ["test_batch.bin"])
+    print(f"wrote {args.train} train + {args.test} test records to "
+          f"{args.out_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Plot precision-vs-step from a run's metrics.jsonl files.
+
+The analog of the reference's results/cifar10.jpeg ("Best Precision" curve
+from TensorBoard, reference README.md:35-38) — rendered straight from the
+JSONL metrics channel so it works without TensorBoard.
+
+Usage: python tools/plot_convergence.py <log_root> <out.png> [title]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def read_jsonl(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def main():
+    log_root = sys.argv[1]
+    out_png = sys.argv[2]
+    title = sys.argv[3] if len(sys.argv) > 3 else "Precision vs step"
+    rows = read_jsonl(os.path.join(log_root, "train", "metrics.jsonl"))
+
+    train = [(r["step"], r["precision"]) for r in rows if "precision" in r]
+    evals = [(r["step"], r["eval/precision"]) for r in rows
+             if "eval/precision" in r]
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7.2, 4.2), dpi=130)
+    blue, orange = "#2563EB", "#D97706"
+    if train:
+        ax.plot(*zip(*train), color=blue, linewidth=1.2, alpha=0.45,
+                label="train batch precision")
+    if evals:
+        ax.plot(*zip(*evals), color=orange, linewidth=2.0, marker="o",
+                markersize=5, label="eval precision (10k held-out)")
+        bx, by = max(evals, key=lambda t: t[1])
+        ax.annotate(f"best {by:.3f}", (bx, by), textcoords="offset points",
+                    xytext=(-8, 10), fontsize=9, color="#374151")
+    ax.set_xlabel("training step")
+    ax.set_ylabel("top-1 precision")
+    ax.set_ylim(0, 1.02)
+    ax.set_title(title, fontsize=11)
+    ax.grid(True, color="#E5E7EB", linewidth=0.6)
+    for spine in ("top", "right"):
+        ax.spines[spine].set_visible(False)
+    ax.legend(loc="lower right", frameon=False, fontsize=9)
+    fig.tight_layout()
+    fig.savefig(out_png)
+    print(f"wrote {out_png} ({len(train)} train pts, {len(evals)} eval pts)")
+
+
+if __name__ == "__main__":
+    main()
