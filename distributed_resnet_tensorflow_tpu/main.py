@@ -69,6 +69,32 @@ def _make_train_source(cfg: ExperimentConfig, trainer: Trainer):
         batch_size=_per_process_batch(cfg.train.batch_size, nproc))
 
 
+def _peek(data_iter):
+    """(first_batch_or_None, iterator yielding the same stream)."""
+    import itertools
+    try:
+        first = next(data_iter)
+    except StopIteration:
+        return None, data_iter
+    return first, itertools.chain([first], data_iter)
+
+
+def _write_input_grid(writer: MetricsWriter, batch, trainer: Trainer) -> None:
+    """One grid of raw input images at step 1 (reference cifar_input.py:114
+    logged every summarized batch; once is the useful part)."""
+    import numpy as np
+    if "idx" in batch and trainer._dev_data is not None:
+        # gather the 8 rows ON DEVICE; np.asarray of the full HBM dataset
+        # would pull ~600 MB to host for 8 images
+        import jax.numpy as jnp
+        idx8 = jnp.asarray(np.asarray(batch["idx"])[:8])
+        images = np.asarray(trainer._dev_data[0][idx8])
+    else:
+        images = batch.get("images")
+    if images is not None:
+        writer.write_images(1, "inputs", np.asarray(images)[:8])
+
+
 def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     """Build → (maybe) restore → train with hooks. Returns (state, metrics)."""
     trainer = Trainer(cfg)
@@ -87,17 +113,29 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
             start_step = int(trainer.state.step)
             log.info("resumed from checkpoint at step %d", start_step)
 
+    data_iter = _make_train_source(cfg, trainer)
+
+    # peek ONE batch to (a) log an input-image grid (parity with the
+    # reference's tf.summary.image of input batches, cifar_input.py:114) and
+    # (b) optionally pre-lower the step for MFU logging; then chain it back
+    writer = None
+    step_flops = None
+    if is_chief():
+        writer = MetricsWriter(os.path.join(cfg.log_root, "train"))
+        first, data_iter = _peek(data_iter)
+        if first is not None:
+            _write_input_grid(writer, first, trainer)
+            if cfg.train.log_mfu:
+                step_flops = trainer.step_flops(first)
+
     hooks = [NanGuardHook(every_steps=max(cfg.train.log_every_steps, 1))]
     if is_chief():
         hooks.append(LoggingHook(cfg.train.log_every_steps,
                                  batch_size=cfg.train.batch_size,
-                                 print_fn=print))
-        writer = MetricsWriter(os.path.join(cfg.log_root, "train"))
+                                 print_fn=print, step_flops=step_flops))
         hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
-
-    data_iter = _make_train_source(cfg, trainer)
 
     num_steps = max_steps if max_steps is not None else cfg.train.train_steps
     state, metrics = trainer.train(data_iter, num_steps=num_steps,

@@ -295,7 +295,8 @@ class ImageNetResNetV2(nn.Module):
 
 
 def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
-                 remat: bool = False, bn_groups: int = 1) -> nn.Module:
+                 remat: bool = False, bn_groups: int = 1,
+                 mesh=None) -> nn.Module:
     """Model factory; replaces the dataset dispatch in reference
     resnet_model.py:69-76 (which hard-coded resnet_size=50 for both)."""
     dtype = jnp.dtype(model_cfg.compute_dtype)
@@ -306,15 +307,23 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
     if model_cfg.name == "vit":
         from .transformer import VisionTransformer
         attn = model_cfg.attention_impl
+        seq = mesh.shape.get("seq", 1) if mesh is not None else 1
         if attn == "auto":
-            # TPU defaults to the Pallas flash kernel; elsewhere dense
-            attn = "flash" if jax.default_backend() == "tpu" else "dense"
+            # a seq axis routes through ring attention (sequence parallel);
+            # otherwise TPU defaults to the Pallas flash kernel, else dense
+            if seq > 1:
+                attn = "ring"
+            else:
+                attn = "flash" if jax.default_backend() == "tpu" else "dense"
+        if attn == "ring" and seq <= 1:
+            raise ValueError(
+                "attention_impl='ring' requires mesh.sequence > 1")
         return VisionTransformer(
             num_classes=model_cfg.num_classes,
             patch_size=model_cfg.vit_patch_size,
             dim=model_cfg.vit_dim, depth=model_cfg.vit_depth,
             num_heads=model_cfg.vit_heads, dtype=dtype,
-            attention_impl=attn, remat=remat)
+            attention_impl=attn, remat=remat, mesh=mesh)
     if dataset in ("cifar10", "cifar100", "synthetic"):
         return CifarResNetV2(
             resnet_size=model_cfg.resnet_size,

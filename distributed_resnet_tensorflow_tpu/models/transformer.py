@@ -21,9 +21,23 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 
-def _apply_attention(q, k, v, impl: str):
+def _batch_axes(mesh) -> tuple:
+    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+
+
+def _constrain(x: jax.Array, mesh, spec: "P") -> jax.Array:
+    """with_sharding_constraint when a mesh is attached (no-op otherwise) —
+    pins GSPMD's layout choice at the block boundaries."""
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _apply_attention(q, k, v, impl: str, mesh=None):
     if impl == "dense":
         from ..ops.attention import attention
         return attention(q, k, v)
@@ -33,6 +47,14 @@ def _apply_attention(q, k, v, impl: str):
     if impl == "flash":
         from ..ops.pallas import flash_attention
         return flash_attention(q, k, v)
+    if impl == "ring":
+        from ..ops.attention import ring_attention_sharded
+        if mesh is None or mesh.shape.get("seq", 1) <= 1:
+            raise ValueError(
+                "attention_impl='ring' needs a mesh with a seq axis > 1 "
+                "(set mesh.sequence and pass the mesh to the model)")
+        return ring_attention_sharded(q, k, v, mesh,
+                                      batch_axes=_batch_axes(mesh))
     raise ValueError(f"unknown attention_impl {impl!r}")
 
 
@@ -40,6 +62,7 @@ class MultiHeadAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -47,15 +70,17 @@ class MultiHeadAttention(nn.Module):
         if d % self.num_heads:
             raise ValueError(f"dim {d} not divisible by heads {self.num_heads}")
         hd = d // self.num_heads
-        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype,
-                       name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, self.num_heads, hd)
-        k = k.reshape(b, t, self.num_heads, hd)
-        v = v.reshape(b, t, self.num_heads, hd)
-        out = _apply_attention(q, k, v, self.attention_impl)
-        out = out.reshape(b, t, d)
-        return nn.Dense(d, use_bias=False, dtype=self.dtype, name="proj")(out)
+        # kernels carry an explicit head axis — (D, 3, H, hd) / (H, hd, D) —
+        # so tensor parallelism shards WHOLE heads (see
+        # parallel/sharding.py); a fused (D, 3D) kernel column-sharded over
+        # `tensor` would misalign with the q|k|v split boundaries and force
+        # resharding around the split in every block
+        qkv = nn.DenseGeneral((3, self.num_heads, hd), use_bias=False,
+                              dtype=self.dtype, name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = _apply_attention(q, k, v, self.attention_impl, self.mesh)
+        return nn.DenseGeneral(d, axis=(-2, -1), use_bias=False,
+                               dtype=self.dtype, name="proj")(out)
 
 
 class EncoderBlock(nn.Module):
@@ -63,16 +88,28 @@ class EncoderBlock(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         d = x.shape[-1]
+        mesh = self.mesh
+        tensor = mesh.shape.get("tensor", 1) if mesh is not None else 1
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadAttention(self.num_heads, self.dtype,
-                                   self.attention_impl)(h)
+                                   self.attention_impl, mesh)(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
         h = nn.gelu(h)
+        if tensor > 1:
+            # column-parallel up-projection: hidden dim lives on `tensor`;
+            # the row-parallel down-projection contracts it (XLA all-reduce).
+            # Keep the token dim on `seq` when both parallelisms are active —
+            # replicating it here would all-gather the 4x-dim hidden, the
+            # largest activation, defeating sequence parallelism
+            seq_spec = "seq" if mesh.shape.get("seq", 1) > 1 else None
+            h = _constrain(h, mesh, P(_batch_axes(mesh) or None, seq_spec,
+                                      "tensor"))
         h = nn.Dense(d, dtype=self.dtype)(h)
         return x + h
 
@@ -87,6 +124,11 @@ class VisionTransformer(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"
     remat: bool = False
+    # device mesh for sequence (`seq` axis: ring attention + token sharding)
+    # and tensor parallelism (`tensor` axis: Megatron-style block sharding,
+    # see parallel/sharding.py param_sharding_rule). None = single-device
+    # semantics; the arrays may still be batch-sharded by the caller's jit.
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -104,12 +146,20 @@ class VisionTransformer(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, t, self.dim), jnp.float32)
         x = x + pos.astype(self.dtype)
+        mesh = self.mesh
+        seq = mesh.shape.get("seq", 1) if mesh is not None else 1
+        if seq > 1:
+            if t % seq:
+                raise ValueError(f"{t} tokens not divisible by seq axis {seq}")
+            # tokens sharded over `seq`: LayerNorm/MLP are token-pointwise and
+            # partition cleanly; attention runs the ppermute ring
+            x = _constrain(x, mesh, P(_batch_axes(mesh) or None, "seq", None))
         block = EncoderBlock
         if self.remat:
             block = nn.remat(block)
         for _ in range(self.depth):
             x = block(self.num_heads, self.mlp_ratio, self.dtype,
-                      self.attention_impl)(x)
+                      self.attention_impl, mesh)(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = x.mean(axis=1).astype(jnp.float32)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

@@ -146,12 +146,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, causal: bool = False,
-                           seq_axis: str = "seq") -> jax.Array:
+                           seq_axis: str = "seq",
+                           batch_axes: tuple = ()) -> jax.Array:
     """Convenience wrapper: shard_map ring_attention over ``mesh[seq_axis]``
-    with time-dim sharding (B, T/seq, H, D per device)."""
+    with time-dim sharding (B, T/seq, H, D per device).
+
+    ``batch_axes`` names mesh axes the batch dim is already split over (e.g.
+    ("data",)) so composition with data parallelism keeps the batch sharded
+    instead of all-gathering it at the shard_map boundary."""
     from jax import shard_map
 
-    spec = P(None, seq_axis, None, None)
+    spec = P(batch_axes or None, seq_axis, None, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -18,9 +18,37 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
                         fsdp_min_size: int = 2 ** 16) -> P:
-    """ZeRO-3-style rule: shard the largest dimension of big params over
+    """Parameter placement rule.
+
+    Tensor parallelism (Megatron-style, transformer blocks only): when the
+    ``tensor`` axis is >1, attention heads and the MLP hidden dim split
+    column-/row-wise so each block needs exactly one all-reduce, inserted by
+    XLA at the row-parallel contraction:
+
+        qkv kernel (D, 3, H, hd) → P(None, None, "tensor", None)  (whole heads)
+        out  kernel (H, hd, D)   → P("tensor", None, None)
+        mlp  up    (D, 4D)       → P(None, "tensor")
+        mlp  down  (4D, D)       → P("tensor", None)
+
+    ZeRO-3-style fsdp: shard the largest dimension of big params over
     ``fsdp`` when it divides evenly; small params stay replicated (a sharded
     1-D BN scale buys nothing and costs collective latency)."""
+    tensor = mesh.shape.get("tensor", 1)
+    if tensor > 1 and ("EncoderBlock" in path or "MultiHeadAttention" in path):
+        if "kernel" in path:
+            if "qkv" in path and len(shape) == 4 and shape[2] % tensor == 0:
+                return P(None, None, "tensor", None)
+            if "proj" in path and len(shape) == 3 and shape[0] % tensor == 0:
+                return P("tensor", None, None)
+            if "Dense_0" in path and len(shape) == 2 \
+                    and shape[1] % tensor == 0:
+                return P(None, "tensor")
+            if "Dense_1" in path and len(shape) == 2 \
+                    and shape[0] % tensor == 0:
+                return P("tensor", None)
+        if "bias" in path and len(shape) == 1 and "Dense_0" in path \
+                and shape[0] % tensor == 0:
+            return P("tensor")
     fsdp = mesh.shape["fsdp"]
     if fsdp <= 1 or int(np.prod(shape)) < fsdp_min_size:
         return P()

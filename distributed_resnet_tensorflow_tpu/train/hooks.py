@@ -32,10 +32,11 @@ class LoggingHook:
     resnet_cifar_main.py:280-285)."""
 
     def __init__(self, every_steps: int = 20, batch_size: int = 0,
-                 print_fn=None):
+                 print_fn=None, step_flops: Optional[float] = None):
         self.every_steps = max(1, every_steps)
         self.throughput = Throughput(batch_size)
         self.print_fn = print_fn or (lambda s: log.info("%s", s))
+        self.step_flops = step_flops  # enables an MFU column when known
         self._last = 0
 
     def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
@@ -51,6 +52,11 @@ class LoggingHook:
             parts.append(f"{tp['steps_per_sec']:.2f} stp/s")
             if self.throughput.batch_size:
                 parts.append(f"{tp['images_per_sec']:.0f} img/s")
+            if self.step_flops:
+                from ..utils.profiling import mfu
+                util = mfu(tp["steps_per_sec"], self.step_flops)
+                if util is not None:
+                    parts.append(f"mfu {util * 100:.1f}%")
         self.print_fn("  ".join(parts))
 
 

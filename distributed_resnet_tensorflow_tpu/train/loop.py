@@ -224,8 +224,24 @@ class Trainer:
         # False: reference-faithful per-replica BN — one moment group per
         # batch shard (see ops/batch_norm.py).
         bn_groups = 1 if cfg.model.cross_replica_bn else batch_shard_count(self.mesh)
+        # reject dead-axis configs loudly (a >1 axis that shards nothing
+        # would silently waste chips): seq/tensor only have consumers in the
+        # transformer family; pipeline/expert have none yet
+        for axis in ("pipeline", "expert"):
+            if self.mesh.shape.get(axis, 1) > 1:
+                raise ValueError(
+                    f"mesh axis {axis!r} > 1 has no consumer in any model "
+                    "family yet; use data/fsdp (and seq/tensor with vit)")
+        if cfg.model.name != "vit":
+            for axis in ("seq", "tensor"):
+                if self.mesh.shape.get(axis, 1) > 1:
+                    raise ValueError(
+                        f"mesh axis {axis!r} > 1 requires model.name='vit' "
+                        f"(got {cfg.model.name!r}); ResNets parallelize over "
+                        "data/fsdp")
         self.model = create_model(cfg.model, cfg.data.dataset,
-                                  remat=cfg.train.remat, bn_groups=bn_groups)
+                                  remat=cfg.train.remat, bn_groups=bn_groups,
+                                  mesh=self.mesh)
         self.schedule = create_schedule(cfg.optimizer)
         decay_in_loss = cfg.optimizer.name != "lars"
         if cfg.optimizer.decay_all_params and not decay_in_loss:
@@ -285,8 +301,12 @@ class Trainer:
     def init_state(self, seed: Optional[int] = None) -> TrainState:
         rng = jax.random.PRNGKey(self.cfg.train.seed if seed is None else seed)
         c = self.cfg
-        shape = (1, c.data.image_size, c.data.image_size, 3) \
-            if c.model.name != "logistic" else (1, c.model.input_size)
+        # one example per batch shard: shard_map-based ops (ring attention)
+        # need the init dummy batch divisible by the batch mesh axes
+        from ..parallel.mesh import batch_shard_count
+        nb = batch_shard_count(self.mesh)
+        shape = (nb, c.data.image_size, c.data.image_size, 3) \
+            if c.model.name != "logistic" else (nb, c.model.input_size)
         self.state = create_train_state(rng, self.model, self.tx, shape,
                                         mesh=self.mesh)
         return self.state
@@ -398,9 +418,24 @@ class Trainer:
                 in_shardings=(st_sh, {"idx": b_sh}, rep, rep),
                 out_shardings=(st_sh, None),
                 donate_argnums=(0,))
+            self._jitted_idx_raw = jit_fn
             self._jitted_idx = \
                 lambda s, b: jit_fn(s, b, *self._dev_data)
         return self._jitted_idx
+
+    def step_flops(self, batch) -> Optional[float]:
+        """XLA cost-analysis FLOPs of one compiled optimizer step. ``batch``
+        is one host batch as the training iterator yields it ({"images",..}
+        or {"idx"}). Uses the same jit entry training uses, so the lowering
+        warms the compile cache rather than adding a compile."""
+        from ..utils import profiling
+        if self._dev_data is not None and "idx" in batch:
+            self.jitted_index_step()
+            return profiling.flops_per_step(
+                self._jitted_idx_raw, self.state, self._put_idx(batch),
+                *self._dev_data)
+        return profiling.flops_per_step(
+            self.jitted_train_step(), self.state, self._put_batch(batch))
 
     def jitted_index_multi_step(self, k: int = 0):
         del k

@@ -71,14 +71,10 @@ def state_shardings(state_shapes, mesh: Mesh):
     the fsdp rule; step/batch_stats replicated)."""
     param_sh = tree_param_shardings(state_shapes.params, mesh)
     rep = NamedSharding(mesh, P())
-
-    def opt_leaf_sharding(leaf):
-        # optimizer moments mirror param shapes; reuse the rule by shape
-        from ..parallel.sharding import param_sharding_rule
-        spec = param_sharding_rule("opt", jnp.shape(leaf), mesh)
-        return NamedSharding(mesh, spec)
-
-    opt_sh = jax.tree_util.tree_map(opt_leaf_sharding, state_shapes.opt_state)
+    # optimizer moments mirror the param tree INCLUDING names (optax states
+    # embed the param pytree), so the name-aware rule (fsdp + tensor) applies
+    # to them identically; scalar counters fall through to replicated
+    opt_sh = tree_param_shardings(state_shapes.opt_state, mesh)
     bs_sh = jax.tree_util.tree_map(lambda _: rep, state_shapes.batch_stats)
     return TrainState(step=rep, params=param_sh, batch_stats=bs_sh,
                       opt_state=opt_sh, apply_fn=state_shapes.apply_fn,

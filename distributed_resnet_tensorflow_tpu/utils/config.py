@@ -135,6 +135,8 @@ class TrainConfig:
     # reference's fused TF op, resnet_model.py:78-80):
     # auto = on iff TPU | on | interpret (CPU tests) | off
     fused_xent: str = "auto"
+    # print MFU in the logging hook (XLA cost-analysis FLOPs / peak)
+    log_mfu: bool = False
 
 
 @dataclass
