@@ -308,13 +308,11 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
         from .transformer import VisionTransformer
         attn = model_cfg.attention_impl
         seq = mesh.shape.get("seq", 1) if mesh is not None else 1
-        if attn == "auto":
+        if attn == "auto" and seq > 1:
             # a seq axis routes through ring attention (sequence parallel);
-            # otherwise TPU defaults to the Pallas flash kernel, else dense
-            if seq > 1:
-                attn = "ring"
-            else:
-                attn = "flash" if jax.default_backend() == "tpu" else "dense"
+            # the remaining flash-vs-dense choice is made at trace time
+            # where the true token count is known (transformer._apply_attention)
+            attn = "ring"
         if attn == "ring" and seq <= 1:
             raise ValueError(
                 "attention_impl='ring' requires mesh.sequence > 1")

@@ -38,6 +38,16 @@ def _constrain(x: jax.Array, mesh, spec: "P") -> jax.Array:
 
 
 def _apply_attention(q, k, v, impl: str, mesh=None):
+    if impl == "auto":
+        # resolved HERE, where the true sequence length is known at trace
+        # time: ring when a seq mesh axis exists; the Pallas flash kernel on
+        # TPU past its measured ~2k-token crossover vs dense; else dense
+        if mesh is not None and mesh.shape.get("seq", 1) > 1:
+            impl = "ring"
+        elif jax.default_backend() == "tpu" and q.shape[1] >= 2048:
+            impl = "flash"
+        else:
+            impl = "dense"
     if impl == "dense":
         from ..ops.attention import attention
         return attention(q, k, v)

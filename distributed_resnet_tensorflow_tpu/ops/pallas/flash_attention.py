@@ -1,16 +1,17 @@
-"""Flash attention — Pallas TPU kernel (forward) with recompute backward.
+"""Flash attention — Pallas TPU kernels, fused forward AND backward.
 
 Canonical TPU tiling: grid (batch·heads, q_blocks, k_blocks) with the k-block
 dimension innermost and sequential ("arbitrary" semantics); online-softmax
 accumulators (m, l, acc) live in VMEM scratch and persist across the k-block
 iterations, so VMEM holds only one (block_q, d) query tile and one
 (block_k, d) key/value tile at a time — O(block) VMEM, any sequence length.
-Output is written on the last k iteration.
+Output (+ the logsumexp residual) is written on the last k iteration.
 
-The backward pass recomputes attention via the lax blockwise implementation
-(ops/attention.py) under ``jax.vjp`` — O(T) memory, one extra forward, no
-O(T²) residuals (flash-attention v1 strategy). A fused Pallas backward is the
-known next step.
+The backward is the flash-attention-2 formulation in two Pallas passes that
+recompute P per tile from (q, k, lse) — no O(T²) residuals and no extra full
+forward: a dQ kernel marching k-blocks innermost, and a dK/dV kernel
+marching q-blocks innermost, with Δ = rowsum(dO ∘ O) precomputed as one
+fused elementwise pass.
 
 Layout: (B, T, H, D). The wrapper pads T up to lcm(block_q, block_k) and D to
 the 128-lane width; padded keys are masked via ``valid_len``, padded queries
@@ -40,8 +41,8 @@ BLOCK_Q = 256
 BLOCK_K = 256
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale, causal, valid_len, block_q, block_k, nk):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                  *, scale, causal, valid_len, block_q, block_k, nk):
     """One (q-block, k-block) tile. Scratch m/l/acc persist across the
     innermost (k-block) grid dimension."""
     qi = pl.program_id(1)
@@ -59,10 +60,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        # keep matmul OPERANDS in the input dtype (bf16 on the MXU's native
+        # rate — an f32 cast would halve/quarter throughput); accumulate f32
+        q = q_ref[0]                                      # (bq, d)
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
         if valid_len is not None:
@@ -78,33 +81,48 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         m_ref[:] = m_new
         l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_prev * corr + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(kj == nk - 1)
     def _finalize():
         l = l_ref[:]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # logsumexp residual for the fused backward: lse = m + log(l);
+        # guard fully-masked rows (m = -inf) to keep exp(s - lse) finite
+        m = m_ref[:]
+        lse_ref[0] = jnp.where(m <= _NEG_INF / 2, 0.0, m + jnp.log(l))
 
 
-def _flash_forward(q, k, v, causal=False, interpret=False,
-                   block_q=BLOCK_Q, block_k=BLOCK_K):
-    b, t, h, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-    # clamp blocks to the (padded) sequence, keeping them a multiple of the
-    # TPU sublane tile (16 covers bf16's (16,128) and f32's (8,128)) so
-    # Mosaic accepts shapes like t=196 (ViT-224/16)
+def _geometry(t, d, block_q, block_k):
+    """Common fwd/bwd tiling: clamp blocks to the (padded) sequence, keeping
+    them a multiple of the TPU sublane tile (16 covers bf16's (16,128) and
+    f32's (8,128)) so Mosaic accepts shapes like t=196 (ViT-224/16)."""
     t16 = -(-t // 16) * 16
     block_q = min(block_q, t16)
     block_k = min(block_k, t16)
     step = math.lcm(block_q, block_k)
     tpad = (-t) % step
     dpad = (-d) % 128
+    return block_q, block_k, tpad, dpad
 
-    def fold(x):  # (B,T,H,D) → (B·H, T, D)
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    qf, kf, vf = fold(q), fold(k), fold(v)
+def _fold(x, b, h, d):  # (B,T,H,D) → (B·H, T, D)
+    return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+
+def _unfold(x, b, h, t, d):  # (B·H, T, D) → (B,T,H,D)
+    return x.reshape(b, h, x.shape[1], x.shape[2])[:, :, :t, :d] \
+        .transpose(0, 2, 1, 3)
+
+
+def _flash_forward(q, k, v, causal=False, interpret=False,
+                   block_q=BLOCK_Q, block_k=BLOCK_K, return_residuals=False):
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q, block_k, tpad, dpad = _geometry(t, d, block_q, block_k)
+
+    qf, kf, vf = (_fold(x, b, h, d) for x in (q, k, v))
     if tpad or dpad:
         pad = ((0, 0), (0, tpad), (0, dpad))
         qf, kf, vf = (jnp.pad(x, pad) for x in (qf, kf, vf))
@@ -129,7 +147,7 @@ def _flash_forward(q, k, v, causal=False, interpret=False,
         extra = dict(compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")))
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -140,35 +158,215 @@ def _flash_forward(q, k, v, causal=False, interpret=False,
             pl.BlockSpec((1, block_k, dp), lambda bh, i, j: (bh, j, 0),
                          memory_space=_VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, i, j: (bh, i, 0),
-                               memory_space=_VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, tp, dp), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, i, j: (bh, i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0),
+                         memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tp, dp), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tp, 1), jnp.float32),
+        ],
         scratch_shapes=scratch,
         interpret=interpret,
         **extra,
     )(qf, kf, vf)
-    return out[:, :t, :d].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    # the lse output is computed even when discarded (no-grad path): a
+    # second kernel variant isn't worth the (B·H, Tp, 1) f32 write it saves
+    out_bthd = _unfold(out, b, h, t, d)
+    if return_residuals:
+        return out_bthd, lse
+    return out_bthd
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, valid_len, block_q, block_k, nk):
+    """dQ pass: grid (B·H, nq, nk), k-blocks innermost/sequential.
+    dS = P ∘ (dO·Vᵀ − Δ); dQ = scale · dS·K   (flash-attention-2 backward)."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = jnp.logical_or(not causal,
+                          kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0]                                   # (bq, 1)
+        delta = delta_ref[0]                               # (bq, 1)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = None
+        if valid_len is not None:
+            mask = k_pos < valid_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            cm = q_pos >= k_pos
+            mask = cm if mask is None else jnp.logical_and(mask, cm)
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, valid_len, block_q, block_k, nq):
+    """dK/dV pass: grid (B·H, nk, nq), q-blocks innermost/sequential.
+    dV = Pᵀ·dO;  dK = scale · dSᵀ·Q."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = jnp.logical_or(not causal,
+                          kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = None
+        if valid_len is not None:
+            mask = k_pos < valid_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            cm = q_pos >= k_pos
+            mask = cm if mask is None else jnp.logical_and(mask, cm)
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dv_acc[:] += jnp.dot(p.astype(do.dtype).T, do,
+                             preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal=False, interpret=False,
+                    block_q=BLOCK_Q, block_k=BLOCK_K):
+    """Fused Pallas backward: recomputes P per tile from (q, k, lse) — no
+    O(T²) residuals, two passes over the kv/q grids."""
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q, block_k, tpad, dpad = _geometry(t, d, block_q, block_k)
+
+    qf, kf, vf, dof, of = (_fold(x, b, h, d) for x in (q, k, v, g, out))
+    if tpad or dpad:
+        pad = ((0, 0), (0, tpad), (0, dpad))
+        qf, kf, vf, dof, of = (jnp.pad(x, pad)
+                               for x in (qf, kf, vf, dof, of))
+    tp, dp = qf.shape[1], qf.shape[2]
+    nq, nk = tp // block_q, tp // block_k
+    # Δ = rowsum(dO ∘ O): tiny elementwise pass, let XLA fuse it
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # (B·H, tp, 1)
+
+    if not _HAVE_TPU_PARAMS:  # pragma: no cover
+        raise NotImplementedError(
+            "flash_attention requires the Pallas TPU backend; use "
+            "ops.blockwise_attention on this platform")
+
+    common = dict(scale=scale, causal=causal,
+                  valid_len=(t if tpad else None),
+                  block_q=block_q, block_k=block_k)
+
+    # one BlockSpec builder per operand kind; the q/k index maps swap between
+    # the (bh, qi, kj) grid of the dQ pass and the (bh, kj, qi) grid of dK/dV
+    def qb(im):
+        return pl.BlockSpec((1, block_q, dp), im, memory_space=_VMEM)
+
+    def kb(im):
+        return pl.BlockSpec((1, block_k, dp), im, memory_space=_VMEM)
+
+    def rb(im):
+        return pl.BlockSpec((1, block_q, 1), im, memory_space=_VMEM)
+
+    q_at = lambda bh, i, j: (bh, i, 0)    # noqa: E731
+    k_at = lambda bh, i, j: (bh, j, 0)    # noqa: E731
+    q_at2 = lambda bh, j, i: (bh, i, 0)   # noqa: E731
+    k_at2 = lambda bh, j, i: (bh, j, 0)   # noqa: E731
+
+    extra = {}
+    if not interpret:
+        extra = dict(compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, nk=nk, **common),
+        grid=(b * h, nq, nk),
+        in_specs=[qb(q_at), kb(k_at), kb(k_at), qb(q_at), rb(q_at), rb(q_at)],
+        out_specs=qb(q_at),
+        out_shape=jax.ShapeDtypeStruct((b * h, tp, dp), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        interpret=interpret,
+        **extra,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, nq=nq, **common),
+        grid=(b * h, nk, nq),
+        in_specs=[qb(q_at2), kb(k_at2), kb(k_at2), qb(q_at2), rb(q_at2),
+                  rb(q_at2)],
+        out_specs=[kb(k_at2), kb(k_at2)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tp, dp), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tp, dp), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
+                        pltpu.VMEM((block_k, dp), jnp.float32)],
+        interpret=interpret,
+        **extra,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (_unfold(dq, b, h, t, d), _unfold(dk, b, h, t, d),
+            _unfold(dv, b, h, t, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, interpret: bool = False) -> jax.Array:
-    """Pallas flash attention, (B, T, H, D). Differentiable: backward
-    recomputes via the lax blockwise path (O(T) memory)."""
+    """Pallas flash attention, (B, T, H, D). Differentiable with a FUSED
+    Pallas backward (dq + dk/dv kernels recomputing P from the lse
+    residual — O(T) memory, no extra full forward)."""
     return _flash_forward(q, k, v, causal, interpret)
 
 
 def _fa_fwd(q, k, v, causal, interpret):
-    return _flash_forward(q, k, v, causal, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, interpret,
+                              return_residuals=True)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, interpret, res, g):
-    from ..attention import blockwise_attention
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: blockwise_attention(q, k, v,
-                                                         causal=causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
