@@ -311,7 +311,9 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
         if attn == "auto" and seq > 1:
             # a seq axis routes through ring attention (sequence parallel);
             # the remaining flash-vs-dense choice is made at trace time
-            # where the true token count is known (transformer._apply_attention)
+            # where the true token count is known. transformer._apply_attention
+            # applies the SAME rules for direct VisionTransformer users — this
+            # early resolution only makes model.attention_impl introspectable
             attn = "ring"
         if attn == "ring" and seq <= 1:
             raise ValueError(
