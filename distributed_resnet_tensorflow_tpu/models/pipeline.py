@@ -1,0 +1,229 @@
+"""Pipeline parallelism — GPipe-style microbatched encoder over the
+``pipeline`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.10: pure data
+parallel); this module completes the mesh: every axis of
+(data, fsdp, seq, tensor, pipeline) now has a consumer. Design:
+
+  * The encoder's per-layer parameters are STACKED on a leading depth axis
+    and sharded over ``pipeline`` (each stage holds depth/P layers) — the
+    pipeline analog of the fsdp/tensor rules in parallel/sharding.py.
+  * Execution is a ``shard_map`` over the pipeline axis running the GPipe
+    schedule as one ``lax.scan`` over M + P - 1 ticks: at tick t, stage s
+    processes microbatch t - s; activations hop stages via
+    ``lax.ppermute`` (ICI neighbor traffic), stage 0 injects microbatches,
+    the last stage collects outputs, and a final masked ``psum`` broadcasts
+    them to every stage. Reverse-mode AD is the transposed schedule (scan
+    reversed, ppermute inverted) — the backward pipeline for free.
+  * Bubble ticks compute on zero-activations and are masked out of the
+    result; the bubble fraction is (P-1)/(M+P-1), so M defaults to 2P.
+
+The block math mirrors ``transformer.EncoderBlock`` op-for-op (pre-LN MHA +
+pre-LN MLP with residuals) but is written against explicit stacked params so
+one program serves every stage. ``pack_encoder_params`` converts a standard
+per-block ViT param tree into the stacked layout (checkpoint migration and
+the exact-parity tests).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+_LN_EPS = 1e-6  # nn.LayerNorm default
+
+
+def _layer_norm(x, scale, bias):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + _LN_EPS)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block_apply(p, x, num_heads, dtype):
+    """One encoder block from a stacked-param slice ``p`` — the explicit-math
+    twin of transformer.EncoderBlock (kept in lockstep; exact-parity test:
+    tests/test_pipeline.py)."""
+    b, t, d = x.shape
+    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = jnp.einsum("btd,dchk->btchk", h, p["qkv_kernel"].astype(dtype))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    from ..ops.attention import attention
+    o = attention(q, k, v)
+    o = jnp.einsum("bthk,hkd->btd", o, p["proj_kernel"].astype(dtype))
+    x = x + o
+    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    h = jnp.einsum("btd,df->btf", h, p["mlp_w1"].astype(dtype)) \
+        + p["mlp_b1"].astype(dtype)
+    h = nn.gelu(h)
+    h = jnp.einsum("btf,fd->btd", h, p["mlp_w2"].astype(dtype)) \
+        + p["mlp_b2"].astype(dtype)
+    return x + h
+
+
+class PipelinedEncoder(nn.Module):
+    """Stacked-parameter transformer encoder, pipelined when
+    ``mesh.shape['pipeline'] > 1`` (plain scan over layers otherwise)."""
+
+    depth: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None
+    microbatches: int = 0  # 0 → 2 × pipeline stages
+    remat: bool = False    # jax.checkpoint each block (GPipe's usual pairing)
+
+    def _params(self, d):
+        hd = d // self.num_heads
+        f = self.mlp_ratio * d
+        vs = jax.nn.initializers.variance_scaling
+        def stacked(name, shape, init):
+            return self.param(name, init, (self.depth,) + shape, jnp.float32)
+        ones = lambda key, shape, dtype: jnp.ones(shape, dtype)   # noqa: E731
+        zeros = nn.initializers.zeros
+        return {
+            "ln1_scale": stacked("ln1_scale", (d,), ones),
+            "ln1_bias": stacked("ln1_bias", (d,), zeros),
+            "qkv_kernel": stacked(
+                "qkv_kernel", (d, 3, self.num_heads, hd),
+                vs(1.0, "fan_in", "truncated_normal", in_axis=1,
+                   out_axis=(2, 3, 4), batch_axis=0)),
+            "proj_kernel": stacked(
+                "proj_kernel", (self.num_heads, hd, d),
+                vs(1.0, "fan_in", "truncated_normal", in_axis=(1, 2),
+                   out_axis=3, batch_axis=0)),
+            "ln2_scale": stacked("ln2_scale", (d,), ones),
+            "ln2_bias": stacked("ln2_bias", (d,), zeros),
+            "mlp_w1": stacked(
+                "mlp_w1", (d, f),
+                vs(1.0, "fan_in", "truncated_normal", in_axis=1, out_axis=2,
+                   batch_axis=0)),
+            "mlp_b1": stacked("mlp_b1", (f,), zeros),
+            "mlp_w2": stacked(
+                "mlp_w2", (f, d),
+                vs(1.0, "fan_in", "truncated_normal", in_axis=1, out_axis=2,
+                   batch_axis=0)),
+            "mlp_b2": stacked("mlp_b2", (d,), zeros),
+        }
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, t, d = x.shape
+        params = self._params(d)
+        nblocks = self.depth
+        pstages = self.mesh.shape.get("pipeline", 1) \
+            if self.mesh is not None else 1
+
+        block_fn = _block_apply
+        if self.remat:
+            block_fn = jax.checkpoint(
+                _block_apply, static_argnums=(2, 3))
+
+        def run_layers(p, h):
+            return lax.scan(
+                lambda hh, pp: (block_fn(pp, hh, self.num_heads,
+                                         self.dtype), None),
+                h, p)[0]
+
+        if pstages > 1 and nblocks % pstages:
+            raise ValueError(
+                f"depth {nblocks} not divisible by pipeline stages {pstages}")
+        m = self.microbatches or 2 * pstages
+        # microbatching applies to the LOCAL batch: each data-parallel shard
+        # runs its own pipeline over its slice of the batch
+        n_batch_shards = 1
+        if self.mesh is not None:
+            for a in ("data", "fsdp"):
+                n_batch_shards *= self.mesh.shape.get(a, 1)
+        local_b = b // max(1, n_batch_shards)
+        if pstages <= 1:
+            return run_layers(params, x)
+        if local_b < m or local_b % m:
+            # the shape-only init dummy may be too small to microbatch —
+            # parameters are created identically on both paths, so it runs
+            # sequentially; a REAL batch in this state must fail loudly
+            # (a silent sequential fallback would idle P-1 stages)
+            if self.is_initializing():
+                return run_layers(params, x)
+            raise ValueError(
+                f"local batch {local_b} (global {b} over {n_batch_shards} "
+                f"batch shards) must be a multiple of microbatches {m}")
+
+        mesh = self.mesh
+        batch_axes = tuple(a for a in ("data", "fsdp")
+                           if mesh.shape.get(a, 1) > 1)
+        x_spec = P(batch_axes or None, None, None)
+        p_spec = jax.tree_util.tree_map(
+            lambda leaf: P(*(("pipeline",) + (None,) * (leaf.ndim - 1))),
+            params)
+        perm = [(i, (i + 1) % pstages) for i in range(pstages)]
+
+        def pipelined(p_local, xg):
+            stage = lax.axis_index("pipeline")
+            mb = xg.shape[0] // m
+            xs = xg.reshape((m, mb) + xg.shape[1:])
+
+            def tick(carry, tt):
+                prev, out = carry
+                recv = lax.ppermute(prev, "pipeline", perm)
+                inject = lax.dynamic_index_in_dim(
+                    xs, jnp.clip(tt, 0, m - 1), axis=0, keepdims=False)
+                h = jnp.where(stage == 0, inject, recv)
+                y = run_layers(p_local, h)
+                idx = tt - (pstages - 1)
+                upd = lax.dynamic_update_index_in_dim(
+                    out, y.astype(out.dtype), jnp.clip(idx, 0, m - 1), axis=0)
+                write = jnp.logical_and(stage == pstages - 1,
+                                        jnp.logical_and(idx >= 0, idx < m))
+                out = jnp.where(write, upd, out)
+                return (y, out), None
+
+            zero = jnp.zeros((mb,) + xg.shape[1:], xg.dtype)
+            out0 = jnp.zeros_like(xs)
+            (last, out), _ = lax.scan(tick, (zero, out0),
+                                      jnp.arange(m + pstages - 1))
+            # outputs live on the last stage only; masked psum broadcasts
+            out = lax.psum(
+                jnp.where(stage == pstages - 1, out, jnp.zeros_like(out)),
+                "pipeline")
+            return out.reshape(xg.shape)
+
+        from jax.experimental.shard_map import shard_map
+        kwargs = dict(mesh=mesh, in_specs=(p_spec, x_spec),
+                      out_specs=x_spec)
+        try:
+            fn = shard_map(pipelined, check_vma=False, **kwargs)
+        except TypeError:  # older jax spells it check_rep
+            fn = shard_map(pipelined, check_rep=False, **kwargs)
+        return fn(params, x)
+
+
+def pack_encoder_params(vit_params: dict, depth: int) -> dict:
+    """Stack a standard per-block ViT param tree (EncoderBlock_i modules)
+    into the PipelinedEncoder layout — checkpoint migration between the
+    unpipelined and pipelined parameterizations."""
+    def block(i):
+        return vit_params[f"EncoderBlock_{i}"]
+
+    def stack(fn):
+        return jnp.stack([jnp.asarray(fn(block(i))) for i in range(depth)])
+
+    return {
+        "ln1_scale": stack(lambda b: b["LayerNorm_0"]["scale"]),
+        "ln1_bias": stack(lambda b: b["LayerNorm_0"]["bias"]),
+        "qkv_kernel": stack(
+            lambda b: b["MultiHeadAttention_0"]["qkv"]["kernel"]),
+        "proj_kernel": stack(
+            lambda b: b["MultiHeadAttention_0"]["proj"]["kernel"]),
+        "ln2_scale": stack(lambda b: b["LayerNorm_1"]["scale"]),
+        "ln2_bias": stack(lambda b: b["LayerNorm_1"]["bias"]),
+        "mlp_w1": stack(lambda b: b["Dense_0"]["kernel"]),
+        "mlp_b1": stack(lambda b: b["Dense_0"]["bias"]),
+        "mlp_w2": stack(lambda b: b["Dense_1"]["kernel"]),
+        "mlp_b2": stack(lambda b: b["Dense_1"]["bias"]),
+    }

@@ -323,7 +323,8 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
             patch_size=model_cfg.vit_patch_size,
             dim=model_cfg.vit_dim, depth=model_cfg.vit_depth,
             num_heads=model_cfg.vit_heads, dtype=dtype,
-            attention_impl=attn, remat=remat, mesh=mesh)
+            attention_impl=attn, remat=remat, mesh=mesh,
+            pipeline_microbatches=model_cfg.vit_pipeline_microbatches)
     if dataset in ("cifar10", "cifar100", "synthetic"):
         return CifarResNetV2(
             resnet_size=model_cfg.resnet_size,

@@ -134,11 +134,13 @@ class VisionTransformer(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"
     remat: bool = False
-    # device mesh for sequence (`seq` axis: ring attention + token sharding)
-    # and tensor parallelism (`tensor` axis: Megatron-style block sharding,
-    # see parallel/sharding.py param_sharding_rule). None = single-device
-    # semantics; the arrays may still be batch-sharded by the caller's jit.
+    # device mesh for sequence (`seq` axis: ring attention + token sharding),
+    # tensor (`tensor` axis: Megatron-style block sharding, see
+    # parallel/sharding.py param_sharding_rule), and pipeline (`pipeline`
+    # axis: GPipe microbatching, models/pipeline.py) parallelism. None =
+    # single-device semantics; arrays may still be batch-sharded by jit.
     mesh: Any = None
+    pipeline_microbatches: int = 0  # 0 → 2 × pipeline stages
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -158,18 +160,35 @@ class VisionTransformer(nn.Module):
         x = x + pos.astype(self.dtype)
         mesh = self.mesh
         seq = mesh.shape.get("seq", 1) if mesh is not None else 1
+        pipeline = mesh.shape.get("pipeline", 1) if mesh is not None else 1
         if seq > 1:
             if t % seq:
                 raise ValueError(f"{t} tokens not divisible by seq axis {seq}")
             # tokens sharded over `seq`: LayerNorm/MLP are token-pointwise and
             # partition cleanly; attention runs the ppermute ring
             x = _constrain(x, mesh, P(_batch_axes(mesh) or None, "seq", None))
-        block = EncoderBlock
-        if self.remat:
-            block = nn.remat(block)
-        for _ in range(self.depth):
-            x = block(self.num_heads, self.mlp_ratio, self.dtype,
-                      self.attention_impl, mesh)(x)
+        if pipeline > 1:
+            # GPipe microbatch pipeline over stacked-parameter stages
+            # (models/pipeline.py); parameterization differs from the
+            # per-block modules (pack_encoder_params converts)
+            if self.attention_impl not in ("auto", "dense"):
+                raise ValueError(
+                    "pipeline parallelism supports dense attention only "
+                    f"(got attention_impl={self.attention_impl!r})")
+            from .pipeline import PipelinedEncoder
+            x = PipelinedEncoder(depth=self.depth, num_heads=self.num_heads,
+                                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                                 mesh=mesh,
+                                 microbatches=self.pipeline_microbatches,
+                                 remat=self.remat,
+                                 name="encoder")(x)
+        else:
+            block = EncoderBlock
+            if self.remat:
+                block = nn.remat(block)
+            for _ in range(self.depth):
+                x = block(self.num_heads, self.mlp_ratio, self.dtype,
+                          self.attention_impl, mesh)(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = x.mean(axis=1).astype(jnp.float32)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

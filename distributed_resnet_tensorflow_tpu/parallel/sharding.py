@@ -33,6 +33,14 @@ def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
     ZeRO-3-style fsdp: shard the largest dimension of big params over
     ``fsdp`` when it divides evenly; small params stay replicated (a sharded
     1-D BN scale buys nothing and costs collective latency)."""
+    pipeline = mesh.shape.get("pipeline", 1)
+    if pipeline > 1 and "['encoder']" in path and shape \
+            and shape[0] % pipeline == 0:
+        # PipelinedEncoder stacks per-layer params on a leading depth axis;
+        # sharding it over `pipeline` puts each stage's weights (and
+        # optimizer moments) on its own stage — matching the shard_map
+        # in_specs so no per-step resharding is needed
+        return P(*(("pipeline",) + (None,) * (len(shape) - 1)))
     tensor = mesh.shape.get("tensor", 1)
     if tensor > 1 and ("EncoderBlock" in path or "MultiHeadAttention" in path):
         if "kernel" in path:

@@ -225,20 +225,25 @@ class Trainer:
         # batch shard (see ops/batch_norm.py).
         bn_groups = 1 if cfg.model.cross_replica_bn else batch_shard_count(self.mesh)
         # reject dead-axis configs loudly (a >1 axis that shards nothing
-        # would silently waste chips): seq/tensor only have consumers in the
-        # transformer family; pipeline/expert have none yet
-        for axis in ("pipeline", "expert"):
-            if self.mesh.shape.get(axis, 1) > 1:
-                raise ValueError(
-                    f"mesh axis {axis!r} > 1 has no consumer in any model "
-                    "family yet; use data/fsdp (and seq/tensor with vit)")
+        # would silently waste chips): seq/tensor/pipeline only have
+        # consumers in the transformer family; expert has none yet
+        if self.mesh.shape.get("expert", 1) > 1:
+            raise ValueError(
+                "mesh axis 'expert' > 1 has no consumer in any model family "
+                "yet; use data/fsdp (and seq/tensor/pipeline with vit)")
         if cfg.model.name != "vit":
-            for axis in ("seq", "tensor"):
+            for axis in ("seq", "tensor", "pipeline"):
                 if self.mesh.shape.get(axis, 1) > 1:
                     raise ValueError(
                         f"mesh axis {axis!r} > 1 requires model.name='vit' "
                         f"(got {cfg.model.name!r}); ResNets parallelize over "
                         "data/fsdp")
+        elif self.mesh.shape.get("pipeline", 1) > 1:
+            for axis in ("seq", "tensor"):
+                if self.mesh.shape.get(axis, 1) > 1:
+                    raise ValueError(
+                        f"pipeline parallelism does not compose with {axis!r}"
+                        " yet; use pipeline x data")
         self.model = create_model(cfg.model, cfg.data.dataset,
                                   remat=cfg.train.remat, bn_groups=bn_groups,
                                   mesh=self.mesh)

@@ -44,6 +44,8 @@ class ModelConfig:
     vit_dim: int = 128
     vit_depth: int = 6
     vit_heads: int = 4
+    # GPipe microbatches when mesh.pipeline > 1 (0 → 2 × stages)
+    vit_pipeline_microbatches: int = 0
     # auto = ring if mesh.sequence>1; flash on TPU at >=2048 tokens; else dense
     attention_impl: str = "auto"      # auto | dense | blockwise | flash | ring
 

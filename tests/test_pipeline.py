@@ -1,0 +1,148 @@
+"""Pipeline parallelism tests (models/pipeline.py) — GPipe schedule over the
+`pipeline` mesh axis on the fake 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_resnet_tensorflow_tpu.models.pipeline import (
+    PipelinedEncoder, _block_apply, pack_encoder_params)
+from distributed_resnet_tensorflow_tpu.models.transformer import EncoderBlock
+from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+
+
+def _mesh(**axes):
+    return create_mesh(MeshConfig(**axes))
+
+
+def test_block_apply_matches_encoder_block():
+    """The explicit stacked-param block math == the module EncoderBlock."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 32).astype(np.float32))
+    block = EncoderBlock(num_heads=4, dtype=jnp.float32)
+    variables = block.init(jax.random.PRNGKey(0), x)
+    want = block.apply(variables, x)
+
+    packed = pack_encoder_params({"EncoderBlock_0": variables["params"]}, 1)
+    p0 = jax.tree_util.tree_map(lambda v: v[0], packed)
+    got = _block_apply(p0, x, num_heads=4, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_full_vit_repacked_pipeline_matches_standard():
+    """A standard per-block ViT's params repacked via pack_encoder_params
+    (depth=4) and run through the pipelined ViT must give the same logits —
+    the checkpoint-migration contract between the two parameterizations."""
+    from distributed_resnet_tensorflow_tpu.models import VisionTransformer
+    depth = 4
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 16, 16, 3).astype(np.float32))
+    std = VisionTransformer(num_classes=4, patch_size=4, dim=32, depth=depth,
+                            num_heads=4, dtype=jnp.float32,
+                            attention_impl="dense")
+    variables = std.init(jax.random.PRNGKey(0), x)
+    want = std.apply(variables, x)
+
+    mesh = _mesh(data=2, pipeline=4)
+    pp = VisionTransformer(num_classes=4, patch_size=4, dim=32, depth=depth,
+                           num_heads=4, dtype=jnp.float32,
+                           attention_impl="dense", mesh=mesh,
+                           pipeline_microbatches=4)
+    std_params = variables["params"]
+    pp_params = {k: v for k, v in std_params.items()
+                 if not k.startswith("EncoderBlock_")}
+    pp_params["encoder"] = pack_encoder_params(std_params, depth)
+    got = jax.jit(lambda p, x: pp.apply({"params": p}, x))(pp_params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipelined_encoder_matches_sequential():
+    """Pipelined execution over 4 stages == plain layer scan: logits AND
+    parameter gradients (the backward pipeline) to fp32 tolerance."""
+    depth = 4
+    mesh = _mesh(data=2, pipeline=4)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 8, 32).astype(np.float32))
+
+    enc_seq = PipelinedEncoder(depth=depth, num_heads=4, dtype=jnp.float32,
+                               mesh=None)
+    enc_pp = PipelinedEncoder(depth=depth, num_heads=4, dtype=jnp.float32,
+                              mesh=mesh, microbatches=4)
+    variables = enc_seq.init(jax.random.PRNGKey(0), x)
+
+    def loss(enc):
+        def fn(params, x):
+            y = enc.apply({"params": params}, x)
+            return (y ** 2).sum(), y
+        return fn
+
+    (ls, ys), gs = jax.jit(jax.value_and_grad(
+        loss(enc_seq), has_aux=True))(variables["params"], x)
+    (lp, yp), gp = jax.jit(jax.value_and_grad(
+        loss(enc_pp), has_aux=True))(variables["params"], x)
+    np.testing.assert_allclose(np.asarray(yp), np.asarray(ys),
+                               rtol=2e-4, atol=2e-4)
+    assert np.isclose(float(lp), float(ls), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(gs),
+                    jax.tree_util.tree_leaves(gp)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-3, atol=3e-4)
+
+
+def test_pipelined_vit_through_trainer():
+    """mesh.pipeline > 1 routes the ViT encoder through the GPipe path via
+    the Trainer; training runs and stays finite."""
+    from distributed_resnet_tensorflow_tpu.data import (
+        learnable_synthetic_iterator)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+    cfg = get_preset("smoke")
+    cfg.model.name = "vit"
+    cfg.model.num_classes = 4
+    cfg.model.compute_dtype = "float32"
+    cfg.model.vit_dim = 32
+    cfg.model.vit_depth = 4
+    cfg.model.vit_heads = 2
+    cfg.data.image_size = 8
+    cfg.train.batch_size = 8
+    cfg.mesh.data = 2
+    cfg.mesh.pipeline = 4
+    cfg.model.vit_pipeline_microbatches = 4  # local batch 4 → mb of 1
+    cfg.optimizer.weight_decay = 0.0
+    tr = Trainer(cfg)
+    tr.init_state()
+    state, m = tr.train(learnable_synthetic_iterator(8, 8, 4), num_steps=2)
+    assert int(state.step) == 2
+    assert np.isfinite(float(m["loss"]))
+    # the stacked encoder params exist (pipelined parameterization)
+    assert "encoder" in state.params
+
+
+def test_pipeline_unsupported_combos_rejected():
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+    cfg = get_preset("smoke")
+    cfg.model.name = "vit"
+    cfg.mesh.data = 2
+    cfg.mesh.pipeline = 2
+    cfg.mesh.tensor = 2
+    with pytest.raises(ValueError, match="compose"):
+        Trainer(cfg)
+
+
+def test_pipeline_validation_errors():
+    mesh = _mesh(data=2, pipeline=4)
+    enc = PipelinedEncoder(depth=6, num_heads=2, dtype=jnp.float32, mesh=mesh)
+    x = jnp.zeros((8, 8, 32), jnp.float32)
+    with pytest.raises(ValueError, match="divisible by pipeline"):
+        enc.init(jax.random.PRNGKey(0), x)
+    # indivisible microbatches: init falls back (shape-only dummy), but a
+    # real apply must fail loudly rather than silently idle P-1 stages
+    enc2 = PipelinedEncoder(depth=4, num_heads=2, dtype=jnp.float32,
+                            mesh=mesh, microbatches=3)
+    variables = enc2.init(jax.random.PRNGKey(0), x)
+    with pytest.raises(ValueError, match="microbatches"):
+        enc2.apply(variables, x)

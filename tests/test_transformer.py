@@ -183,10 +183,16 @@ def test_dead_mesh_axes_rejected():
     cfg.mesh.tensor = 2
     with pytest.raises(ValueError, match="tensor"):
         Trainer(cfg)
-    # pipeline/expert have no consumer in ANY model family yet
+    # expert has no consumer in ANY model family yet
     cfg2 = get_preset("smoke")
     cfg2.model.name = "vit"
     cfg2.mesh.data = 4
-    cfg2.mesh.pipeline = 2
-    with pytest.raises(ValueError, match="pipeline"):
+    cfg2.mesh.expert = 2
+    with pytest.raises(ValueError, match="expert"):
         Trainer(cfg2)
+    # pipeline for a non-transformer model is rejected
+    cfg3 = get_preset("smoke")
+    cfg3.mesh.data = 4
+    cfg3.mesh.pipeline = 2
+    with pytest.raises(ValueError, match="pipeline"):
+        Trainer(cfg3)
