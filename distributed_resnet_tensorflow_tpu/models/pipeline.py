@@ -136,10 +136,11 @@ class PipelinedEncoder(nn.Module):
         m = self.microbatches or 2 * pstages
         # microbatching applies to the LOCAL batch: each data-parallel shard
         # runs its own pipeline over its slice of the batch
-        n_batch_shards = 1
         if self.mesh is not None:
-            for a in ("data", "fsdp"):
-                n_batch_shards *= self.mesh.shape.get(a, 1)
+            from ..parallel.mesh import batch_shard_count
+            n_batch_shards = batch_shard_count(self.mesh)
+        else:
+            n_batch_shards = 1
         local_b = b // max(1, n_batch_shards)
         if pstages <= 1:
             return run_layers(params, x)
@@ -155,9 +156,8 @@ class PipelinedEncoder(nn.Module):
                 f"batch shards) must be a multiple of microbatches {m}")
 
         mesh = self.mesh
-        batch_axes = tuple(a for a in ("data", "fsdp")
-                           if mesh.shape.get(a, 1) > 1)
-        x_spec = P(batch_axes or None, None, None)
+        from .transformer import _batch_axes
+        x_spec = P(_batch_axes(mesh) or None, None, None)
         p_spec = jax.tree_util.tree_map(
             lambda leaf: P(*(("pipeline",) + (None,) * (leaf.ndim - 1))),
             params)
@@ -193,7 +193,10 @@ class PipelinedEncoder(nn.Module):
                 "pipeline")
             return out.reshape(xg.shape)
 
-        from jax.experimental.shard_map import shard_map
+        try:
+            from jax import shard_map  # jax >= 0.8 location
+        except ImportError:  # pragma: no cover
+            from jax.experimental.shard_map import shard_map
         kwargs = dict(mesh=mesh, in_specs=(p_spec, x_spec),
                       out_specs=x_spec)
         try:

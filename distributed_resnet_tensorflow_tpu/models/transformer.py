@@ -171,6 +171,9 @@ class VisionTransformer(nn.Module):
             # GPipe microbatch pipeline over stacked-parameter stages
             # (models/pipeline.py); parameterization differs from the
             # per-block modules (pack_encoder_params converts)
+            # dense only: 'auto' under pipeline MEANS dense (the flash
+            # kernel is not plumbed through the stacked-stage block); other
+            # impls are rejected rather than silently substituted
             if self.attention_impl not in ("auto", "dense"):
                 raise ValueError(
                     "pipeline parallelism supports dense attention only "
