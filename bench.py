@@ -28,7 +28,7 @@ CIFAR_BASELINE_STEPS_PER_SEC = 13.94      # reference README.md:28-30 (1x P100)
 IMAGENET_BASELINE_IMAGES_PER_SEC = 122.9  # 0.96 st/s × bs 128 (README.md:50)
 
 
-def _best_time(fn, state, batches, loops: int, reps: int = 3):
+def _best_time(fn, state, batches, loops: int, reps: int = 5):
     """Best-of-reps wall time for ``loops`` dispatches (remote-tunnel TPU is
     noisy). Returns (final_state, best_seconds)."""
     best = float("inf")

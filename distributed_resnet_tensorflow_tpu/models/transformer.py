@@ -25,7 +25,8 @@ from jax.sharding import PartitionSpec as P
 
 
 def _batch_axes(mesh) -> tuple:
-    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    from ..parallel.mesh import present_batch_axes
+    return present_batch_axes(mesh)
 
 
 def _constrain(x: jax.Array, mesh, spec: "P") -> jax.Array:

@@ -102,13 +102,20 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def present_batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Batch-splitting axes that are actually >1 (tolerates hand-built
+    meshes missing axes). May be empty — callers wanting a PartitionSpec
+    use ``present_batch_axes(mesh) or None``."""
+    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+
+
 def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
     """Mesh axes the global batch is split over."""
-    return tuple(a for a in ("data", "fsdp") if mesh.shape[a] > 1) or ("data",)
+    return present_batch_axes(mesh) or ("data",)
 
 
 def batch_shard_count(mesh: Mesh) -> int:
-    return mesh.shape["data"] * mesh.shape["fsdp"]
+    return mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
