@@ -194,9 +194,54 @@ def bench_imagenet():
     raise RuntimeError(f"no ImageNet batch size fit: {last_err}")
 
 
+def bench_flash_attention(t=4096, iters=10):
+    """Long-context attention: fused Pallas flash (fwd+bwd kernels) vs XLA
+    dense autodiff at T=4096 causal bf16 — the regime ring/flash exist for.
+    Timed inside a lax.scan (the remote-tunnel dispatch floor would swamp
+    per-call timing)."""
+    import jax.numpy as jnp
+    from distributed_resnet_tensorflow_tpu.ops.attention import attention
+    from distributed_resnet_tensorflow_tpu.ops.pallas import flash_attention
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, t, 8, 64).astype(np.float32))
+               .astype(jnp.bfloat16) for _ in range(3))
+
+    def grad_scan(attn_fn):
+        g = jax.grad(lambda q, k, v: attn_fn(q, k, v)
+                     .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+
+        @jax.jit
+        def run(q, k, v):
+            def body(qq, _):
+                dq, dk, dv = g(qq, k, v)
+                return qq + 1e-6 * dq.astype(qq.dtype), ()
+            return jax.lax.scan(body, q, None, length=iters)[0]
+        return run
+
+    def timeit(run):
+        run(q, k, v)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = run(q, k, v)
+            float(jnp.sum(out.astype(jnp.float32)))
+            best = min(best, (time.perf_counter() - t0) / iters * 1000)
+        return best
+
+    fused = timeit(grad_scan(
+        lambda q, k, v: flash_attention(q, k, v, True, False)))
+    dense = timeit(grad_scan(
+        lambda q, k, v: attention(q, k, v, causal=True)))
+    return {"seq_len": t, "fused_grad_ms": round(fused, 2),
+            "dense_grad_ms": round(dense, 2),
+            "speedup": round(dense / fused, 2)}
+
+
 def main():
     cifar = bench_cifar()
     imagenet = bench_imagenet()
+    flash = bench_flash_attention()
     print(json.dumps({
         "metric": "cifar10_resnet50_bs128_train_steps_per_sec",
         "value": cifar["steps_per_sec"],
@@ -205,6 +250,7 @@ def main():
             cifar["steps_per_sec"] / CIFAR_BASELINE_STEPS_PER_SEC, 2),
         "cifar": cifar,
         "imagenet_resnet50": imagenet,
+        "flash_attention_causal": flash,
         "device": jax.devices()[0].device_kind,
     }))
 

@@ -1,6 +1,6 @@
 from .mesh import (  # noqa: F401
     AXES,
-    batch_axes,
+    present_batch_axes,
     batch_shard_count,
     create_mesh,
     data_sharding,

@@ -109,11 +109,6 @@ def present_batch_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
 
 
-def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
-    """Mesh axes the global batch is split over."""
-    return present_batch_axes(mesh) or ("data",)
-
-
 def batch_shard_count(mesh: Mesh) -> int:
     return mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
 
