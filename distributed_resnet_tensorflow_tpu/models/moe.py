@@ -1,0 +1,114 @@
+"""Mixture-of-Experts MLP (Switch-style top-1 routing) — the consumer of the
+``expert`` mesh axis.
+
+The reference is a dense-only trainer (SURVEY.md §2.10); this completes the
+6-axis mesh so every axis has a model consumer. Design (Switch Transformer
+recipe, scoped to what the ViT family needs):
+
+  * E expert MLPs with stacked parameters (E, D, F)/(E, F, D), sharded over
+    the ``expert`` axis by parallel/sharding.py's rule — each device group
+    holds E/expert_axis experts (and their optimizer moments).
+  * Top-1 routing with probability gating and a fixed per-expert capacity
+    ``ceil(tokens/E · capacity_factor)``; over-capacity tokens fall through
+    on the residual path (standard Switch behavior).
+  * Dispatch/combine are one-hot einsums — GSPMD partitions them over the
+    sharded expert dimension and inserts the token exchange collectives.
+    This is the sharding-first formulation (no hand-written all-to-all);
+    optimal a2a scheduling is left to XLA.
+  * The Switch load-balancing auxiliary loss (E · Σ_e fraction_e · prob_e)
+    is sown into the ``losses`` collection; the train step adds every sown
+    loss scaled by ``model.moe_aux_weight`` (train/loop.py).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class SwitchMlp(nn.Module):
+    """Drop-in replacement for the EncoderBlock MLP: LN'd input in,
+    residual-branch output out. Shapes: (B, T, D) → (B, T, D)."""
+
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, t, d = x.shape
+        e = self.num_experts
+        f = self.mlp_ratio * d
+        n_tokens = b * t
+        import math
+        capacity = max(1, math.ceil((n_tokens / e) * self.capacity_factor))
+
+        vs = jax.nn.initializers.variance_scaling
+        w1 = self.param("w1", vs(1.0, "fan_in", "truncated_normal",
+                                 in_axis=1, out_axis=2, batch_axis=0),
+                        (e, d, f), jnp.float32)
+        # "bias" in the name keeps these out of weight decay / LARS trust
+        # scaling (the optimizer masks exclude *bias* leaves by path, since
+        # expert-stacked biases are 2-D and defeat the ndim heuristic)
+        b1 = self.param("bias1", nn.initializers.zeros, (e, f), jnp.float32)
+        w2 = self.param("w2", vs(1.0, "fan_in", "truncated_normal",
+                                 in_axis=1, out_axis=2, batch_axis=0),
+                        (e, f, d), jnp.float32)
+        b2 = self.param("bias2", nn.initializers.zeros, (e, d), jnp.float32)
+
+        # --- router (replicated, fp32 for a stable softmax) ---------------
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32))                       # (B, T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        flat_probs = probs.reshape(n_tokens, e)
+        expert_idx = jnp.argmax(flat_probs, axis=-1)     # (N,)
+        gate = jnp.max(flat_probs, axis=-1)              # (N,)
+
+        # Switch aux loss: E * Σ_e (fraction of tokens routed to e) · (mean
+        # router prob of e) — pushes the router toward uniform utilization
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        fraction = onehot.mean(axis=0)
+        mean_prob = flat_probs.mean(axis=0)
+        self.sow("losses", "moe_aux", e * jnp.sum(fraction * mean_prob))
+
+        # --- capacity assignment ------------------------------------------
+        # position of each token within its expert's queue; >= capacity drops
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (N, E)
+        pos = jnp.sum(pos_in_expert, axis=-1).astype(jnp.int32)      # (N,)
+        keep = pos < capacity
+        gate = gate * keep.astype(jnp.float32)
+
+        # dispatch: (N, E, C) one-hot — token n feeds slot (expert, pos)
+        dispatch = (onehot[:, :, None]
+                    * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
+                    * keep[:, None, None].astype(jnp.float32))
+        combine = dispatch * gate[:, None, None]
+
+        flat_x = x.reshape(n_tokens, d)
+        # expert inputs (E, C, D): GSPMD shards the E dim over `expert`
+        ein = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype),
+                         flat_x.astype(self.dtype))
+        ein = self._constrain_e(ein)
+        h = jnp.einsum("ecd,edf->ecf", ein, w1.astype(self.dtype)) \
+            + b1[:, None, :].astype(self.dtype)
+        h = nn.gelu(h)
+        eout = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype)) \
+            + b2[:, None, :].astype(self.dtype)
+        eout = self._constrain_e(eout)
+        out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), eout)
+        return out.reshape(b, t, d)
+
+    def _constrain_e(self, arr):
+        """Pin the expert dim to the `expert` axis so expert compute stays
+        where the weights live."""
+        mesh = self.mesh
+        if mesh is None or mesh.shape.get("expert", 1) <= 1:
+            return arr
+        from jax.sharding import NamedSharding
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, P("expert", None, None)))

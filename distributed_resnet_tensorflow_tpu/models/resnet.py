@@ -324,7 +324,9 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
             dim=model_cfg.vit_dim, depth=model_cfg.vit_depth,
             num_heads=model_cfg.vit_heads, dtype=dtype,
             attention_impl=attn, remat=remat, mesh=mesh,
-            pipeline_microbatches=model_cfg.vit_pipeline_microbatches)
+            pipeline_microbatches=model_cfg.vit_pipeline_microbatches,
+            num_experts=model_cfg.vit_num_experts,
+            expert_capacity_factor=model_cfg.vit_expert_capacity_factor)
     if dataset in ("cifar10", "cifar100", "synthetic"):
         return CifarResNetV2(
             resnet_size=model_cfg.resnet_size,

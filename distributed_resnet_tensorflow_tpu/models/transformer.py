@@ -100,6 +100,8 @@ class EncoderBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"
     mesh: Any = None
+    num_experts: int = 0             # >0 → Switch MoE MLP (models/moe.py)
+    expert_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -110,6 +112,12 @@ class EncoderBlock(nn.Module):
         x = x + MultiHeadAttention(self.num_heads, self.dtype,
                                    self.attention_impl, mesh)(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.num_experts > 0:
+            from .moe import SwitchMlp
+            return x + SwitchMlp(
+                num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
+                capacity_factor=self.expert_capacity_factor,
+                dtype=self.dtype, mesh=mesh)(h)
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
         h = nn.gelu(h)
         if tensor > 1:
@@ -142,6 +150,8 @@ class VisionTransformer(nn.Module):
     # single-device semantics; arrays may still be batch-sharded by jit.
     mesh: Any = None
     pipeline_microbatches: int = 0  # 0 → 2 × pipeline stages
+    num_experts: int = 0            # >0 → Switch MoE MLPs over `expert`
+    expert_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -179,6 +189,9 @@ class VisionTransformer(nn.Module):
                 raise ValueError(
                     "pipeline parallelism supports dense attention only "
                     f"(got attention_impl={self.attention_impl!r})")
+            if self.num_experts > 0:
+                raise ValueError(
+                    "pipeline parallelism does not support MoE blocks yet")
             from .pipeline import PipelinedEncoder
             x = PipelinedEncoder(depth=self.depth, num_heads=self.num_heads,
                                  mlp_ratio=self.mlp_ratio, dtype=self.dtype,
@@ -192,7 +205,10 @@ class VisionTransformer(nn.Module):
                 block = nn.remat(block)
             for _ in range(self.depth):
                 x = block(self.num_heads, self.mlp_ratio, self.dtype,
-                          self.attention_impl, mesh)(x)
+                          self.attention_impl, mesh,
+                          num_experts=self.num_experts,
+                          expert_capacity_factor=self.expert_capacity_factor,
+                          )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = x.mean(axis=1).astype(jnp.float32)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

@@ -41,6 +41,12 @@ def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
         # optimizer moments) on its own stage — matching the shard_map
         # in_specs so no per-step resharding is needed
         return P(*(("pipeline",) + (None,) * (len(shape) - 1)))
+    expert = mesh.shape.get("expert", 1)
+    if expert > 1 and "SwitchMlp" in path and "router" not in path \
+            and shape and shape[0] % expert == 0:
+        # Switch MoE expert-stacked weights: each expert group holds its
+        # own experts (+ moments); the router stays replicated
+        return P(*(("expert",) + (None,) * (len(shape) - 1)))
     tensor = mesh.shape.get("tensor", 1)
     if tensor > 1 and ("EncoderBlock" in path or "MultiHeadAttention" in path):
         if "kernel" in path:

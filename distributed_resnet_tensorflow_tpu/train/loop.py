@@ -100,7 +100,8 @@ def make_train_step(schedule: Callable, weight_decay: float,
                     decay_all_params: bool = False,
                     ce_fn: Optional[Callable] = None,
                     augment_fn: Optional[Callable] = None,
-                    augment_seed: int = 0):
+                    augment_seed: int = 0,
+                    aux_loss_weight: float = 0.01):
     """Build the pure train_step(state, batch) -> (state, metrics).
 
     ``augment_fn(images, rng) -> images`` runs device-side augmentation at
@@ -119,7 +120,7 @@ def make_train_step(schedule: Callable, weight_decay: float,
     def loss_fn(params, batch_stats, images, labels, apply_fn):
         variables = {"params": params, "batch_stats": batch_stats}
         logits, mutated = apply_fn(variables, images, train=True,
-                                   mutable=["batch_stats"])
+                                   mutable=["batch_stats", "losses"])
         ce = ce_fn(logits, labels)
         loss = ce
         if decay_in_loss:
@@ -127,6 +128,11 @@ def make_train_step(schedule: Callable, weight_decay: float,
             # decay_all_params toggles kernels-only vs all-trainables
             loss = loss + loss_weight_decay(params, weight_decay,
                                             decay_all_params)
+        # auxiliary losses sown by modules (e.g. the Switch MoE
+        # load-balancing term, models/moe.py)
+        aux = jax.tree_util.tree_leaves(mutated.get("losses", {}))
+        if aux:
+            loss = loss + aux_loss_weight * sum(jnp.sum(a) for a in aux)
         return loss, (ce, logits, mutated["batch_stats"])
 
     def single_step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
@@ -225,25 +231,39 @@ class Trainer:
         # batch shard (see ops/batch_norm.py).
         bn_groups = 1 if cfg.model.cross_replica_bn else batch_shard_count(self.mesh)
         # reject dead-axis configs loudly (a >1 axis that shards nothing
-        # would silently waste chips): seq/tensor/pipeline only have
-        # consumers in the transformer family; expert has none yet
-        if self.mesh.shape.get("expert", 1) > 1:
-            raise ValueError(
-                "mesh axis 'expert' > 1 has no consumer in any model family "
-                "yet; use data/fsdp (and seq/tensor/pipeline with vit)")
+        # would silently waste chips): seq/tensor/pipeline/expert only have
+        # consumers in the transformer family
         if cfg.model.name != "vit":
-            for axis in ("seq", "tensor", "pipeline"):
+            for axis in ("seq", "tensor", "pipeline", "expert"):
                 if self.mesh.shape.get(axis, 1) > 1:
                     raise ValueError(
                         f"mesh axis {axis!r} > 1 requires model.name='vit' "
                         f"(got {cfg.model.name!r}); ResNets parallelize over "
                         "data/fsdp")
-        elif self.mesh.shape.get("pipeline", 1) > 1:
-            for axis in ("seq", "tensor"):
-                if self.mesh.shape.get(axis, 1) > 1:
+        else:
+            n_exp_axis = self.mesh.shape.get("expert", 1)
+            if n_exp_axis > 1:
+                if cfg.model.vit_num_experts <= 0:
                     raise ValueError(
-                        f"pipeline parallelism does not compose with {axis!r}"
-                        " yet; use pipeline x data")
+                        "mesh axis 'expert' > 1 requires a MoE model: set "
+                        "model.vit_num_experts")
+                if cfg.model.vit_num_experts % n_exp_axis:
+                    raise ValueError(
+                        f"vit_num_experts={cfg.model.vit_num_experts} not "
+                        f"divisible by the expert axis ({n_exp_axis})")
+            if cfg.model.vit_num_experts > 0 and \
+                    self.mesh.shape.get("tensor", 1) > 1:
+                # no sharding rule splits expert MLPs over `tensor`; the
+                # dominant FLOPs would silently replicate on every chip
+                raise ValueError(
+                    "MoE blocks do not compose with tensor parallelism "
+                    "yet; shard experts over mesh.expert instead")
+            if self.mesh.shape.get("pipeline", 1) > 1:
+                for axis in ("seq", "tensor", "expert"):
+                    if self.mesh.shape.get(axis, 1) > 1:
+                        raise ValueError(
+                            "pipeline parallelism does not compose with "
+                            f"{axis!r} yet; use pipeline x data")
         self.model = create_model(cfg.model, cfg.data.dataset,
                                   remat=cfg.train.remat, bn_groups=bn_groups,
                                   mesh=self.mesh)
@@ -300,7 +320,8 @@ class Trainer:
             decay_all_params=cfg.optimizer.decay_all_params,
             ce_fn=make_ce_fn(cfg.optimizer.label_smoothing,
                              cfg.train.fused_xent, self.mesh),
-            augment_fn=aug_fn, augment_seed=cfg.train.seed)
+            augment_fn=aug_fn, augment_seed=cfg.train.seed,
+            aux_loss_weight=cfg.model.moe_aux_weight)
 
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:

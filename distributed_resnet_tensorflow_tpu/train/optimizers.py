@@ -62,6 +62,9 @@ def _non_bn_mask(params):
         names = [str(p) for p in path]
         if any("BatchNorm" in n for n in names):
             return False
+        # expert-stacked MoE biases are 2-D; exclude biases by name too
+        if names and "bias" in names[-1]:
+            return False
         return leaf.ndim > 1
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
@@ -84,8 +87,14 @@ def loss_weight_decay(params, rate: float, all_params: bool = False):
 
     if rate == 0.0:
         return 0.0
+
+    def kernel_like(path, leaf):
+        # 2-D+ non-bias leaves; "bias" checked by name because
+        # expert-stacked MoE biases are 2-D (models/moe.py)
+        return leaf.ndim > 1 and "bias" not in str(path[-1])
+
     leaves = [leaf for path, leaf in
               jax.tree_util.tree_flatten_with_path(params)[0]
-              if all_params or leaf.ndim > 1]
+              if all_params or kernel_like(path, leaf)]
     return 0.5 * rate * sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
                             for l in leaves)

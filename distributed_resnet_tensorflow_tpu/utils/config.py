@@ -46,6 +46,11 @@ class ModelConfig:
     vit_heads: int = 4
     # GPipe microbatches when mesh.pipeline > 1 (0 → 2 × stages)
     vit_pipeline_microbatches: int = 0
+    # Switch MoE: >0 replaces the block MLPs with num_experts experts
+    # (models/moe.py), shardable over mesh.expert
+    vit_num_experts: int = 0
+    vit_expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01      # Switch load-balancing loss weight
     # auto = ring if mesh.sequence>1; flash on TPU at >=2048 tokens; else dense
     attention_impl: str = "auto"      # auto | dense | blockwise | flash | ring
 
