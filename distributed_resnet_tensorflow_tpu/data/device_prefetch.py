@@ -78,7 +78,11 @@ def threaded_iterator(src: Iterator, depth: int = 2,
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.2)
-                return True
+                # re-check after the put: the consumer's shutdown drain may
+                # have freed the slot we just filled — starting another
+                # next(src) now would outlive the join and leak nested
+                # workers, so report shutdown even though the put landed
+                return not stop.is_set()
             except queue_mod.Full:
                 continue
         return False
@@ -92,7 +96,8 @@ def threaded_iterator(src: Iterator, depth: int = 2,
         except BaseException as e:  # surface on the consumer thread
             put_checked(_WorkerError(e))
 
-    threading.Thread(target=worker, daemon=True, name=name).start()
+    thread = threading.Thread(target=worker, daemon=True, name=name)
+    thread.start()
     try:
         while True:
             item = q.get()
@@ -103,9 +108,22 @@ def threaded_iterator(src: Iterator, depth: int = 2,
             yield item
     finally:
         stop.set()
+        # The worker may still be executing next(src); a generator cannot be
+        # closed from another thread while executing, so unblock any pending
+        # put and join (briefly) before closing. A worker stuck in blocking
+        # IO is a daemon thread — abandoned after the timeout, and close()
+        # then tolerates the cross-thread race.
+        try:
+            q.get_nowait()
+        except queue_mod.Empty:
+            pass
+        thread.join(timeout=1.0)
         close = getattr(src, "close", None)
         if close is not None:
-            close()
+            try:
+                close()
+            except ValueError:  # generator still executing on the worker
+                pass
 
 
 def threaded_stacker(host_iter: Iterator, k: int, depth: int = 2) -> Iterator:

@@ -193,16 +193,9 @@ class PipelinedEncoder(nn.Module):
                 "pipeline")
             return out.reshape(xg.shape)
 
-        try:
-            from jax import shard_map  # jax >= 0.8 location
-        except ImportError:  # pragma: no cover
-            from jax.experimental.shard_map import shard_map
-        kwargs = dict(mesh=mesh, in_specs=(p_spec, x_spec),
-                      out_specs=x_spec)
-        try:
-            fn = shard_map(pipelined, check_vma=False, **kwargs)
-        except TypeError:  # older jax spells it check_rep
-            fn = shard_map(pipelined, check_rep=False, **kwargs)
+        from ..parallel.mesh import shard_map_compat
+        fn = shard_map_compat(pipelined, mesh, in_specs=(p_spec, x_spec),
+                              out_specs=x_spec)
         return fn(params, x)
 
 

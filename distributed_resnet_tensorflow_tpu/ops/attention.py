@@ -154,11 +154,10 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     ``batch_axes`` names mesh axes the batch dim is already split over (e.g.
     ("data",)) so composition with data parallelism keeps the batch sharded
     instead of all-gathering it at the shard_map boundary."""
-    from jax import shard_map
+    from ..parallel.mesh import shard_map_compat
 
     spec = P(batch_axes or None, seq_axis, None, None)
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -113,6 +113,26 @@ def batch_shard_count(mesh: Mesh) -> int:
     return mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
 
 
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions, replication checks off.
+
+    One home for two version dances every caller needs: the import moved
+    out of ``jax.experimental`` in 0.8 (the old alias warns and will be
+    removed), and the don't-check-replication flag was renamed
+    ``check_rep`` → ``check_vma``. Checks stay off because our shard_map
+    bodies wrap collectives/pallas_call, which don't declare varying-mesh
+    -axes info."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - jax < 0.8
+        from jax.experimental.shard_map import shard_map
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        return shard_map(fn, check_rep=False, **kwargs)
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     n = batch_shard_count(mesh)
     if global_batch % n != 0:

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import create_mesh, data_sharding
+from ..parallel.mesh import (batch_shard_count, create_mesh, data_sharding,
+                             present_batch_axes, shard_map_compat)
 from ..parallel.sharding import make_global_batch, shard_batch
 from .optimizers import create_optimizer, loss_weight_decay
 from .schedules import create_schedule
@@ -78,17 +79,13 @@ def make_ce_fn(label_smoothing: float = 0.0, fused_xent: str = "off",
     def per_example(logits, labels):
         return softmax_xent(logits.astype(jnp.float32), labels, interpret)
 
-    if mesh is not None and \
-            mesh.shape["data"] * mesh.shape["fsdp"] > 1:
-        from jax.experimental.shard_map import shard_map
-        batch_spec = P(("data", "fsdp"))
-        kwargs = dict(mesh=mesh,
-                      in_specs=(P(("data", "fsdp"), None), batch_spec),
-                      out_specs=batch_spec)
-        try:  # pallas_call doesn't declare varying-mesh-axes info
-            sharded = shard_map(per_example, check_vma=False, **kwargs)
-        except TypeError:  # older jax spells it check_rep
-            sharded = shard_map(per_example, check_rep=False, **kwargs)
+    if mesh is not None and batch_shard_count(mesh) > 1:
+        batch_axes = present_batch_axes(mesh)
+        batch_spec = P(batch_axes)
+        sharded = shard_map_compat(
+            per_example, mesh,
+            in_specs=(P(batch_axes, None), batch_spec),
+            out_specs=batch_spec)
         return lambda logits, labels: sharded(logits, labels).mean()
     return lambda logits, labels: per_example(logits, labels).mean()
 
@@ -225,7 +222,6 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else create_mesh(cfg.mesh)
         from ..models import create_model
-        from ..parallel.mesh import batch_shard_count
         # cross_replica_bn=True (default): global BN moments — one group.
         # False: reference-faithful per-replica BN — one moment group per
         # batch shard (see ops/batch_norm.py).
@@ -276,12 +272,14 @@ class Trainer:
                 "optimizer.decay_all_params is incompatible with "
                 "optimizer.name='lars' (LARS applies its own masked decay)")
         self.tx = create_optimizer(cfg.optimizer, self.schedule)
-        from ..data import device_augment_enabled, device_dataset_enabled
+        from ..data import device_augment_enabled
         aug_fn = None
-        # a device-resident dataset serves raw uint8, so it implies
-        # device-side augmentation regardless of the device_augment setting
-        if device_augment_enabled(cfg, "train") or \
-                device_dataset_enabled(cfg, "train"):
+        # Only the iterator/step contract decides who augments. A streamed
+        # iterator with device_augment off yields host-augmented float32, so
+        # forcing the device path here would double-augment; when a device
+        # dataset (raw uint8 in HBM) is actually attached,
+        # attach_device_dataset forces the augment step on itself.
+        if device_augment_enabled(cfg, "train"):
             from ..ops.augment import cifar_train_augment
             aug_fn = cifar_train_augment
         self._aug_fn = aug_fn
@@ -329,7 +327,6 @@ class Trainer:
         c = self.cfg
         # one example per batch shard: shard_map-based ops (ring attention)
         # need the init dummy batch divisible by the batch mesh axes
-        from ..parallel.mesh import batch_shard_count
         nb = batch_shard_count(self.mesh)
         shape = (nb, c.data.image_size, c.data.image_size, 3) \
             if c.model.name != "logistic" else (nb, c.model.input_size)
@@ -608,11 +605,12 @@ class Trainer:
         return self.state, metrics
 
     def evaluate(self, data_iter: Iterator, num_batches: int) -> Dict[str, float]:
-        from ..parallel.mesh import batch_shard_count
         from ..parallel.sharding import pad_batch_to_multiple
         step_fn = self.jitted_eval_step()
         n_shards = batch_shard_count(self.mesh)
-        correct, count, loss_sum = 0, 0, 0.0
+        # accumulate ON DEVICE (tiny async adds) and pull once at the end —
+        # a per-batch int() would sync host<->device every eval step
+        totals = None
         for _ in range(num_batches):
             try:
                 batch = next(data_iter)
@@ -633,9 +631,11 @@ class Trainer:
             batch = pad_batch_to_multiple(batch, n_shards)
             batch = self._put_batch(batch)
             out = step_fn(self.state, batch)
-            correct += int(out["correct"])
-            count += int(out["count"])
-            loss_sum += float(out["loss_sum"])
-        return {"precision": correct / max(count, 1),
-                "loss": loss_sum / max(count, 1),
+            totals = out if totals is None else \
+                jax.tree_util.tree_map(jnp.add, totals, out)
+        if totals is None:
+            return {"precision": 0.0, "loss": 0.0, "count": 0}
+        count = int(totals["count"])
+        return {"precision": int(totals["correct"]) / max(count, 1),
+                "loss": float(totals["loss_sum"]) / max(count, 1),
                 "count": count}
