@@ -5,10 +5,16 @@ from .device_dataset import (  # noqa: F401
 
 
 def device_augment_enabled(cfg, mode: str = "train") -> bool:
-    """Single source of truth for who augments — the iterator (yields raw
-    uint8) and the Trainer (applies ops/augment in the jitted step) MUST
-    agree, so both call this."""
-    if mode != "train" or cfg.data.dataset not in ("cifar10", "cifar100"):
+    """Single source of truth for who augments/standardizes — the iterator
+    (yields raw uint8) and the Trainer (applies ops/augment in the jitted
+    step) MUST agree, so both call this.
+
+    cifar*: the device does crop/flip/standardize (ops/augment.py).
+    imagenet: the device does the VGG standardize only (the geometric ops
+    are host-side, tied to per-image source sizes); the iterator then ships
+    uint8 crops — 4× smaller transfers, no host float pass."""
+    if mode != "train" or cfg.data.dataset not in (
+            "cifar10", "cifar100", "imagenet"):
         return False
     setting = cfg.data.device_augment
     if setting == "on":
@@ -43,5 +49,9 @@ def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
         return imagenet_iterator(d.data_dir, bs, mode, image_size=d.image_size,
                                  seed=cfg.train.seed, shard_index=shard_index,
                                  num_shards=num_shards,
-                                 use_native=d.use_native_loader)
+                                 num_decode_threads=d.num_parallel_calls,
+                                 prefetch_batches=d.prefetch_batches,
+                                 use_native=d.use_native_loader,
+                                 device_standardize=device_augment_enabled(
+                                     cfg, mode))
     raise ValueError(f"unknown dataset {d.dataset!r}")

@@ -38,6 +38,74 @@ def decode_jpeg(data: bytes) -> np.ndarray:
     return np.asarray(img, np.uint8)
 
 
+def decode_and_resize(data: bytes, smaller_side: int) -> np.ndarray:
+    """JPEG bytes → RGB uint8 resized so min(h,w) == smaller_side.
+
+    Fuses the decode with the aspect-preserving resize and exploits
+    libjpeg's DCT-domain scaled decode (PIL ``draft``): when the target is
+    ≤ 1/2 the source, the decoder emits 1/2, 1/4 or 1/8-scale pixels
+    directly — decoding a fraction of the blocks — and one bilinear resize
+    lands the exact size. 2-3× faster than full decode + resize on typical
+    ImageNet sources, with only the interpolation path differing from
+    decode_jpeg + _aspect_preserving_resize (DCT box-downscale feeding the
+    bilinear instead of full-res pixels)."""
+    from PIL import Image
+    img = Image.open(io.BytesIO(data))
+    w, h = img.size
+    scale = smaller_side / min(w, h)
+    tw, th = max(1, round(w * scale)), max(1, round(h * scale))
+    img.draft("RGB", (tw, th))  # no-op for non-JPEG or upscales
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    if img.size != (tw, th):
+        img = img.resize((tw, th), Image.BILINEAR)
+    return np.asarray(img, np.uint8)
+
+
+def _random_crop_flip(image: np.ndarray, rng: np.random.RandomState,
+                      output_size: int) -> np.ndarray:
+    """Random output_size² crop + horizontal flip (reference
+    _random_crop:88 + flip). One definition shared by the decoded-array and
+    the fused-decode paths — the RNG draw order (top, left, flip) is part
+    of the contract."""
+    h, w = image.shape[:2]
+    top = rng.randint(0, h - output_size + 1)
+    left = rng.randint(0, w - output_size + 1)
+    crop = image[top:top + output_size, left:left + output_size]
+    if rng.rand() < 0.5:
+        crop = crop[:, ::-1]
+    return crop
+
+
+def _center_crop(image: np.ndarray, output_size: int) -> np.ndarray:
+    """Central crop (reference _central_crop:171)."""
+    h, w = image.shape[:2]
+    top = (h - output_size) // 2
+    left = (w - output_size) // 2
+    return image[top:top + output_size, left:left + output_size]
+
+
+def train_crop_from_bytes(data: bytes, rng: np.random.RandomState,
+                          output_size: int = DEFAULT_IMAGE_SIZE,
+                          resize_side_min: int = RESIZE_SIDE_MIN,
+                          resize_side_max: int = RESIZE_SIDE_MAX) -> np.ndarray:
+    """VGG train preprocessing, uint8 end-to-end (standardization is the
+    device's job — ops/augment.vgg_standardize): random resize side via the
+    fused scaled decode, random crop, random flip."""
+    side = rng.randint(resize_side_min, resize_side_max + 1)
+    image = decode_and_resize(data, side)
+    return np.ascontiguousarray(_random_crop_flip(image, rng, output_size))
+
+
+def eval_crop_from_bytes(data: bytes,
+                         output_size: int = DEFAULT_IMAGE_SIZE,
+                         resize_side: int = RESIZE_SIDE_MIN) -> np.ndarray:
+    """VGG eval preprocessing, uint8: resize-256 (fused scaled decode) then
+    central crop."""
+    return np.ascontiguousarray(
+        _center_crop(decode_and_resize(data, resize_side), output_size))
+
+
 def encode_jpeg(image: np.ndarray, quality: int = 90) -> bytes:
     """RGB uint8 HWC → JPEG bytes (test fixtures / dataset tooling)."""
     from PIL import Image
@@ -62,27 +130,20 @@ def preprocess_for_train(image: np.ndarray, rng: np.random.RandomState,
                          output_size: int = DEFAULT_IMAGE_SIZE,
                          resize_side_min: int = RESIZE_SIDE_MIN,
                          resize_side_max: int = RESIZE_SIDE_MAX) -> np.ndarray:
-    """reference preprocess_for_train:284-314."""
+    """reference preprocess_for_train:284-314 (decoded-array variant; the
+    production train path fuses the decode — train_crop_from_bytes)."""
     side = rng.randint(resize_side_min, resize_side_max + 1)
     image = _aspect_preserving_resize(image, side)
-    h, w = image.shape[:2]
-    top = rng.randint(0, h - output_size + 1)
-    left = rng.randint(0, w - output_size + 1)
-    crop = image[top:top + output_size, left:left + output_size]
-    if rng.rand() < 0.5:
-        crop = crop[:, ::-1]
+    crop = _random_crop_flip(image, rng, output_size)
     return crop.astype(np.float32) / 255.0 - RGB_MEANS
 
 
 def preprocess_for_eval(image: np.ndarray,
                         output_size: int = DEFAULT_IMAGE_SIZE,
                         resize_side: int = RESIZE_SIDE_MIN) -> np.ndarray:
-    """reference preprocess_for_eval:317-333."""
+    """reference preprocess_for_eval:317-333 (decoded-array variant)."""
     image = _aspect_preserving_resize(image, resize_side)
-    h, w = image.shape[:2]
-    top = (h - output_size) // 2
-    left = (w - output_size) // 2
-    crop = image[top:top + output_size, left:left + output_size]
+    crop = _center_crop(image, output_size)
     return crop.astype(np.float32) / 255.0 - RGB_MEANS
 
 

@@ -45,24 +45,37 @@ def _layer_norm(x, scale, bias):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _block_apply(p, x, num_heads, dtype):
+def _block_apply(p, x, num_heads, dtype, tp_axis=None):
     """One encoder block from a stacked-param slice ``p`` — the explicit-math
     twin of transformer.EncoderBlock (kept in lockstep; exact-parity test:
-    tests/test_pipeline.py)."""
+    tests/test_pipeline.py).
+
+    ``tp_axis``: Megatron tensor parallelism inside the pipeline stage. The
+    caller hands this function TENSOR-LOCAL param shards (whole heads of the
+    qkv/proj kernels, columns of mlp_w1/b1, rows of mlp_w2 — the same layout
+    parallel/sharding.py assigns the per-block modules); the two row-parallel
+    contractions (attention out-proj, MLP down-proj) then produce partial
+    sums that one ``lax.psum`` each completes — 2 collectives per block,
+    exactly the Megatron count. Replicated tensors (x, LN params, mlp_b2)
+    stay replicated across ``tp_axis``."""
     b, t, d = x.shape
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     qkv = jnp.einsum("btd,dchk->btchk", h, p["qkv_kernel"].astype(dtype))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     from ..ops.attention import attention
-    o = attention(q, k, v)
+    o = attention(q, k, v)  # local heads only under tp
     o = jnp.einsum("bthk,hkd->btd", o, p["proj_kernel"].astype(dtype))
+    if tp_axis is not None:
+        o = lax.psum(o, tp_axis)
     x = x + o
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     h = jnp.einsum("btd,df->btf", h, p["mlp_w1"].astype(dtype)) \
         + p["mlp_b1"].astype(dtype)
     h = nn.gelu(h)
-    h = jnp.einsum("btf,fd->btd", h, p["mlp_w2"].astype(dtype)) \
-        + p["mlp_b2"].astype(dtype)
+    h = jnp.einsum("btf,fd->btd", h, p["mlp_w2"].astype(dtype))
+    if tp_axis is not None:
+        h = lax.psum(h, tp_axis)
+    h = h + p["mlp_b2"].astype(dtype)
     return x + h
 
 
@@ -119,20 +132,31 @@ class PipelinedEncoder(nn.Module):
         pstages = self.mesh.shape.get("pipeline", 1) \
             if self.mesh is not None else 1
 
+        tp = self.mesh.shape.get("tensor", 1) if self.mesh is not None else 1
+        tp_axis = "tensor" if (tp > 1 and pstages > 1) else None
+
         block_fn = _block_apply
         if self.remat:
             block_fn = jax.checkpoint(
-                _block_apply, static_argnums=(2, 3))
+                _block_apply, static_argnums=(2, 3, 4))
 
-        def run_layers(p, h):
+        def run_layers(p, h, tp_ax=None):
             return lax.scan(
                 lambda hh, pp: (block_fn(pp, hh, self.num_heads,
-                                         self.dtype), None),
+                                         self.dtype, tp_ax), None),
                 h, p)[0]
 
         if pstages > 1 and nblocks % pstages:
             raise ValueError(
                 f"depth {nblocks} not divisible by pipeline stages {pstages}")
+        if tp_axis is not None:
+            if self.num_heads % tp:
+                raise ValueError(
+                    f"heads {self.num_heads} not divisible by tensor axis {tp}")
+            if (self.mlp_ratio * d) % tp:
+                raise ValueError(
+                    f"mlp hidden {self.mlp_ratio * d} not divisible by "
+                    f"tensor axis {tp}")
         m = self.microbatches or 2 * pstages
         # microbatching applies to the LOCAL batch: each data-parallel shard
         # runs its own pipeline over its slice of the batch
@@ -158,9 +182,13 @@ class PipelinedEncoder(nn.Module):
         mesh = self.mesh
         from .transformer import _batch_axes
         x_spec = P(_batch_axes(mesh) or None, None, None)
-        p_spec = jax.tree_util.tree_map(
-            lambda leaf: P(*(("pipeline",) + (None,) * (leaf.ndim - 1))),
-            params)
+        # per-leaf specs MATCH param_sharding_rule's placement (pipeline on
+        # the stacked depth axis, tensor on heads/hidden when tp is active)
+        # so the shard_map consumes the training state's own shards with no
+        # per-step resharding
+        from ..parallel.sharding import stacked_encoder_spec
+        p_spec = {name: stacked_encoder_spec(name, leaf.ndim, tp)
+                  for name, leaf in params.items()}
         perm = [(i, (i + 1) % pstages) for i in range(pstages)]
 
         def pipelined(p_local, xg):
@@ -174,7 +202,7 @@ class PipelinedEncoder(nn.Module):
                 inject = lax.dynamic_index_in_dim(
                     xs, jnp.clip(tt, 0, m - 1), axis=0, keepdims=False)
                 h = jnp.where(stage == 0, inject, recv)
-                y = run_layers(p_local, h)
+                y = run_layers(p_local, h, tp_axis)
                 idx = tt - (pstages - 1)
                 upd = lax.dynamic_update_index_in_dim(
                     out, y.astype(out.dtype), jnp.clip(idx, 0, m - 1), axis=0)

@@ -93,6 +93,7 @@ class BatchNormRelu(nn.Module):
     axis_name: Optional[str] = None
     groups: int = 1
     relu: bool = True
+    stat_subsample: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
@@ -103,6 +104,7 @@ class BatchNormRelu(nn.Module):
             dtype=self.dtype,
             groups=self.groups,
             axis_name=self.axis_name,
+            stat_subsample=self.stat_subsample,
         )(x, train)
         if self.relu:
             x = nn.relu(x)
@@ -122,12 +124,14 @@ class BuildingBlock(nn.Module):
     bn_groups: int = 1
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
+    bn_stat_subsample: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
         bn = partial(BatchNormRelu, momentum=self.bn_momentum,
                      epsilon=self.bn_epsilon, dtype=self.dtype,
-                     axis_name=self.axis_name, groups=self.bn_groups)
+                     axis_name=self.axis_name, groups=self.bn_groups,
+                     stat_subsample=self.bn_stat_subsample)
         conv = partial(ConvFixedPadding, dtype=self.dtype)
         shortcut = x
         x = bn()(x, train)
@@ -151,12 +155,14 @@ class BottleneckBlock(nn.Module):
     bn_groups: int = 1
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
+    bn_stat_subsample: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
         bn = partial(BatchNormRelu, momentum=self.bn_momentum,
                      epsilon=self.bn_epsilon, dtype=self.dtype,
-                     axis_name=self.axis_name, groups=self.bn_groups)
+                     axis_name=self.axis_name, groups=self.bn_groups,
+                     stat_subsample=self.bn_stat_subsample)
         conv = partial(ConvFixedPadding, dtype=self.dtype)
         shortcut = x
         x = bn()(x, train)
@@ -184,6 +190,7 @@ class BlockLayer(nn.Module):
     remat: bool = False
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
+    bn_stat_subsample: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
@@ -200,6 +207,7 @@ class BlockLayer(nn.Module):
                 bn_groups=self.bn_groups,
                 bn_momentum=self.bn_momentum,
                 bn_epsilon=self.bn_epsilon,
+                bn_stat_subsample=self.bn_stat_subsample,
             )(x, train)
         return x
 
@@ -218,6 +226,7 @@ class CifarResNetV2(nn.Module):
     remat: bool = False
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
+    bn_stat_subsample: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -239,10 +248,12 @@ class CifarResNetV2(nn.Module):
                 strides=strides, dtype=self.dtype, axis_name=self.axis_name,
                 bn_groups=self.bn_groups, remat=self.remat,
                 bn_momentum=self.bn_momentum, bn_epsilon=self.bn_epsilon,
+                bn_stat_subsample=self.bn_stat_subsample,
             )(x, train)
         x = BatchNormRelu(momentum=self.bn_momentum, epsilon=self.bn_epsilon,
                           dtype=self.dtype, axis_name=self.axis_name,
-                          groups=self.bn_groups)(x, train)
+                          groups=self.bn_groups,
+                          stat_subsample=self.bn_stat_subsample)(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global avg pool (8x8 at 32px input)
         x = x.astype(jnp.float32)
         return nn.Dense(self.num_classes,
@@ -262,6 +273,7 @@ class ImageNetResNetV2(nn.Module):
     remat: bool = False
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
+    bn_stat_subsample: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -283,10 +295,12 @@ class ImageNetResNetV2(nn.Module):
                 axis_name=self.axis_name, bn_groups=self.bn_groups,
                 remat=self.remat, bn_momentum=self.bn_momentum,
                 bn_epsilon=self.bn_epsilon,
+                bn_stat_subsample=self.bn_stat_subsample,
             )(x, train)
         x = BatchNormRelu(momentum=self.bn_momentum, epsilon=self.bn_epsilon,
                           dtype=self.dtype, axis_name=self.axis_name,
-                          groups=self.bn_groups)(x, train)
+                          groups=self.bn_groups,
+                          stat_subsample=self.bn_stat_subsample)(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global avg pool (7x7 at 224px input)
         x = x.astype(jnp.float32)
         return nn.Dense(self.num_classes,
@@ -333,13 +347,15 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
             num_classes=model_cfg.num_classes,
             width_multiplier=model_cfg.width_multiplier,
             dtype=dtype, axis_name=axis_name, bn_groups=bn_groups, remat=remat,
-            bn_momentum=model_cfg.bn_momentum, bn_epsilon=model_cfg.bn_epsilon)
+            bn_momentum=model_cfg.bn_momentum, bn_epsilon=model_cfg.bn_epsilon,
+            bn_stat_subsample=model_cfg.bn_stat_subsample)
     if dataset == "imagenet":
         return ImageNetResNetV2(
             resnet_size=model_cfg.resnet_size,
             num_classes=model_cfg.num_classes,
             dtype=dtype, axis_name=axis_name, bn_groups=bn_groups, remat=remat,
-            bn_momentum=model_cfg.bn_momentum, bn_epsilon=model_cfg.bn_epsilon)
+            bn_momentum=model_cfg.bn_momentum, bn_epsilon=model_cfg.bn_epsilon,
+            bn_stat_subsample=model_cfg.bn_stat_subsample)
     raise ValueError(f"unknown dataset {dataset!r}")
 
 

@@ -82,3 +82,17 @@ def cifar_train_augment(images: jax.Array, rng: jax.Array,
     """Full train-time pipeline for raw uint8 NHWC batches:
     crop/flip in integer space (like the host path) then standardize."""
     return standardize(random_crop_flip(images, rng, pad))
+
+
+def vgg_standardize(images: jax.Array, rng: jax.Array = None) -> jax.Array:
+    """ImageNet/VGG standardization on device: uint8 → x/255 − RGB means
+    (reference vgg_preprocessing.py:37-39,196-227 — constant means, NOT
+    per-image moments). The random crop/flip/resize stay on the host (they
+    depend on per-image source geometry); moving just this float conversion
+    on-device quarters the host→HBM transfer (uint8 vs f32) and removes the
+    host's per-pixel float pass — the two costs that dominate a streamed
+    224² pipeline after the decode itself."""
+    del rng  # deterministic; matches the augment_fn(images, rng) contract
+    from ..data.preprocessing import RGB_MEANS
+    x = images.astype(jnp.float32) / 255.0
+    return x - jnp.asarray(RGB_MEANS)

@@ -40,6 +40,24 @@ _NEG_INF = -1e30
 BLOCK_Q = 256
 BLOCK_K = 256
 
+# block table (tools/tune_flash_attention.py measures on TPU; bf16 fwd+bwd
+# grad time): seq-length buckets → (block_q, block_k). NOTE an early guess
+# of wider k-blocks (256×512 at T=4096) measured 1.8× SLOWER than 256×256
+# (15.8 vs 8.8 ms) — entries here must come from the tuner, never intuition.
+_BLOCK_TABLE = (
+    (1024, (256, 256)),
+    (2048, (256, 256)),
+    (4096, (256, 256)),
+    (8192, (256, 256)),
+)
+
+
+def _pick_blocks(t: int, d: int) -> tuple:
+    for upper, blocks in _BLOCK_TABLE:
+        if t <= upper:
+            return blocks
+    return _BLOCK_TABLE[-1][1]
+
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale, causal, valid_len, block_q, block_k, nk):
@@ -349,24 +367,37 @@ def _flash_backward(q, k, v, out, lse, g, causal=False, interpret=False,
             _unfold(dv, b, h, t, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = False, interpret: bool = False) -> jax.Array:
+                    causal: bool = False, interpret: bool = False,
+                    block_q: int = 0, block_k: int = 0) -> jax.Array:
     """Pallas flash attention, (B, T, H, D). Differentiable with a FUSED
     Pallas backward (dq + dk/dv kernels recomputing P from the lse
-    residual — O(T) memory, no extra full forward)."""
-    return _flash_forward(q, k, v, causal, interpret)
+    residual — O(T) memory, no extra full forward). ``block_q``/``block_k``
+    of 0 pick the measured-optimal tile for the sequence length
+    (_BLOCK_TABLE; tools/tune_flash_attention.py re-derives it)."""
+    bq, bk = _resolve_blocks(q, block_q, block_k)
+    return _flash_forward(q, k, v, causal, interpret,
+                          block_q=bq, block_k=bk)
 
 
-def _fa_fwd(q, k, v, causal, interpret):
+def _resolve_blocks(q, block_q, block_k):
+    auto_q, auto_k = _pick_blocks(q.shape[1], q.shape[3])
+    return block_q or auto_q, block_k or auto_k
+
+
+def _fa_fwd(q, k, v, causal, interpret, block_q, block_k):
+    bq, bk = _resolve_blocks(q, block_q, block_k)
     out, lse = _flash_forward(q, k, v, causal, interpret,
-                              return_residuals=True)
+                              block_q=bq, block_k=bk, return_residuals=True)
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, interpret, res, g):
+def _fa_bwd(causal, interpret, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, g, causal, interpret)
+    bq, bk = _resolve_blocks(q, block_q, block_k)
+    return _flash_backward(q, k, v, out, lse, g, causal, interpret,
+                           block_q=bq, block_k=bk)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

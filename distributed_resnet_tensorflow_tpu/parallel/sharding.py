@@ -16,6 +16,27 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def stacked_encoder_spec(leaf_name: str, ndim: int, tensor: int = 1) -> P:
+    """PartitionSpec for one PipelinedEncoder stacked-param leaf: ``pipeline``
+    on the leading depth axis, plus (when ``tensor`` > 1) the Megatron
+    placement on the head/hidden axis — whole heads of qkv (L,D,3,H,hd) and
+    proj (L,H,hd,D), columns of mlp_w1 (L,D,F)/mlp_b1 (L,F), rows of
+    mlp_w2 (L,F,D). Single source of truth for BOTH the training-state
+    sharding (param_sharding_rule) and the pipeline shard_map in_specs
+    (models/pipeline.py) — they must agree or every step reshards."""
+    if tensor > 1:
+        spec = {
+            "qkv_kernel": P("pipeline", None, None, "tensor", None),
+            "proj_kernel": P("pipeline", "tensor", None, None),
+            "mlp_w1": P("pipeline", None, "tensor"),
+            "mlp_b1": P("pipeline", "tensor"),
+            "mlp_w2": P("pipeline", "tensor", None),
+        }.get(leaf_name)
+        if spec is not None:
+            return spec
+    return P(*(("pipeline",) + (None,) * (ndim - 1)))
+
+
 def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
                         fsdp_min_size: int = 2 ** 16) -> P:
     """Parameter placement rule.
@@ -37,10 +58,17 @@ def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
     if pipeline > 1 and "['encoder']" in path and shape \
             and shape[0] % pipeline == 0:
         # PipelinedEncoder stacks per-layer params on a leading depth axis;
-        # sharding it over `pipeline` puts each stage's weights (and
-        # optimizer moments) on its own stage — matching the shard_map
-        # in_specs so no per-step resharding is needed
-        return P(*(("pipeline",) + (None,) * (len(shape) - 1)))
+        # sharding it over `pipeline` (× `tensor` on the Megatron axes) puts
+        # each stage's weights (and optimizer moments) on its own devices —
+        # matching the shard_map in_specs so no per-step resharding is needed
+        leaf = path.rsplit("['", 1)[-1].rstrip("]'")
+        spec = stacked_encoder_spec(leaf, len(shape),
+                                    mesh.shape.get("tensor", 1))
+        # only honor a tensor split the shape actually divides
+        for axis_name, dim in zip(spec, shape):
+            if axis_name == "tensor" and dim % mesh.shape["tensor"]:
+                return P(*(("pipeline",) + (None,) * (len(shape) - 1)))
+        return spec
     expert = mesh.shape.get("expert", 1)
     if expert > 1 and "SwitchMlp" in path and "router" not in path \
             and shape and shape[0] % expert == 0:

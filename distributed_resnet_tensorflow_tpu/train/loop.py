@@ -255,11 +255,14 @@ class Trainer:
                     "MoE blocks do not compose with tensor parallelism "
                     "yet; shard experts over mesh.expert instead")
             if self.mesh.shape.get("pipeline", 1) > 1:
-                for axis in ("seq", "tensor", "expert"):
+                # pp composes with dp/fsdp (microbatch over local batch) and
+                # tp (Megatron psums inside each stage, models/pipeline.py);
+                # seq/expert have no stacked-stage implementation yet
+                for axis in ("seq", "expert"):
                     if self.mesh.shape.get(axis, 1) > 1:
                         raise ValueError(
                             "pipeline parallelism does not compose with "
-                            f"{axis!r} yet; use pipeline x data")
+                            f"{axis!r} yet; use pipeline x data x tensor")
         self.model = create_model(cfg.model, cfg.data.dataset,
                                   remat=cfg.train.remat, bn_groups=bn_groups,
                                   mesh=self.mesh)
@@ -280,8 +283,12 @@ class Trainer:
         # dataset (raw uint8 in HBM) is actually attached,
         # attach_device_dataset forces the augment step on itself.
         if device_augment_enabled(cfg, "train"):
-            from ..ops.augment import cifar_train_augment
-            aug_fn = cifar_train_augment
+            if cfg.data.dataset == "imagenet":
+                from ..ops.augment import vgg_standardize
+                aug_fn = vgg_standardize
+            else:
+                from ..ops.augment import cifar_train_augment
+                aug_fn = cifar_train_augment
         self._aug_fn = aug_fn
         self._cfg_aug_fn = aug_fn  # the config-resolved choice, for detach
         self._train_step = self._build_train_step(aug_fn)

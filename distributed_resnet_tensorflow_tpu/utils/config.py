@@ -36,6 +36,11 @@ class ModelConfig:
     cross_replica_bn: bool = True
     bn_momentum: float = 0.997        # reference resnet_model_official.py:37
     bn_epsilon: float = 1e-5          # reference resnet_model_official.py:38
+    # >1: estimate BN batch moments from the contiguous center band of H/s
+    # rows instead of every position — cuts the stat-pass HBM read to 1/s
+    # (ops/batch_norm.py module docstring has the measured story). 1 = exact
+    # moments (default everywhere; reference numerics).
+    bn_stat_subsample: int = 1
     # toy MLP (reference logist_model.py:10-11)
     hidden_units: int = 100
     input_size: int = 32 * 32 * 3
@@ -67,8 +72,10 @@ class DataConfig:
     prefetch_batches: int = 2         # reference prefetches 2*bs samples (resnet_cifar_main.py:232)
     num_parallel_calls: int = 8
     use_native_loader: bool = False   # C++ threaded loader (native/)
-    # crop/flip/standardize inside the jitted step (ops/augment.py) instead
-    # of on the host — auto = on iff TPU. Train-time CIFAR only.
+    # train-time device-side input work (ops/augment.py), auto = on iff TPU.
+    # cifar*: crop/flip/standardize inside the jitted step; imagenet: the
+    # VGG standardize only (iterator then ships raw uint8 crops) — see
+    # data/__init__.py device_augment_enabled, the single source of truth.
     device_augment: str = "auto"      # auto | on | off
     # whole dataset resident in HBM, batches gathered on device, host ships
     # only indices (data/device_dataset.py) — auto = on iff TPU,
@@ -296,6 +303,24 @@ def _imagenet_resnet50_lars32k() -> ExperimentConfig:
     return cfg
 
 
+def _vit_long_context() -> ExperimentConfig:
+    """Long-context ViT: 256² images at patch 4 → 4096 tokens/image — the
+    regime the Pallas flash kernel exists for (attention_impl='auto'
+    resolves to 'flash' on TPU past the measured ~2k-token crossover,
+    models/transformer.py). Beyond-reference capability; the shipped config
+    that exercises the kernel by default."""
+    cfg = ExperimentConfig()
+    cfg.model = ModelConfig(
+        name="vit", num_classes=10, vit_patch_size=4, vit_dim=512,
+        vit_depth=8, vit_heads=8)
+    cfg.data = DataConfig(dataset="synthetic", image_size=256)
+    cfg.optimizer = OptimizerConfig(
+        name="adam", learning_rate=1e-3, weight_decay=0.0,
+        schedule="cosine", warmup_steps=500, total_steps=20000)
+    cfg.train = TrainConfig(batch_size=8, train_steps=20000, remat=True)
+    return cfg
+
+
 def _cifar10_smoke() -> ExperimentConfig:
     """Local smoke test analog of reference scripts/submit_mac_dist.sh
     (1ps+2wk, bs=10, 100 steps on CPU — SURVEY.md §4.1)."""
@@ -313,6 +338,7 @@ PRESETS = {
     "cifar100_wrn28_10": _cifar100_wrn2810,
     "imagenet_resnet50": _imagenet_resnet50,
     "imagenet_resnet50_lars32k": _imagenet_resnet50_lars32k,
+    "vit_long_context": _vit_long_context,
     "smoke": _cifar10_smoke,
 }
 

@@ -156,3 +156,57 @@ def test_imagenet_sharding_disjoint(tmp_path):
 def test_dataset_filenames_missing(tmp_path):
     with pytest.raises(FileNotFoundError):
         dataset_filenames(str(tmp_path), "train")
+
+
+def test_decode_and_resize_matches_two_step():
+    """Fused scaled decode == decode + aspect resize when no DCT scaling
+    kicks in (upscale), and shape/range-correct when it does (downscale)."""
+    from distributed_resnet_tensorflow_tpu.data.preprocessing import (
+        _aspect_preserving_resize, decode_and_resize, decode_jpeg)
+    rng = np.random.RandomState(7)
+    img = rng.randint(0, 256, (96, 128, 3), np.uint8)
+    data = encode_jpeg(img)
+    up = decode_and_resize(data, 192)
+    want = _aspect_preserving_resize(decode_jpeg(data), 192)
+    np.testing.assert_array_equal(up, want)          # draft no-op on upscale
+    # big source → the draft path actually engages (scale <= 1/2). Smooth
+    # content: the two paths differ only in how they band-limit, so pure
+    # pixel noise would decorrelate them while any real image agrees
+    yy, xx = np.mgrid[0:512, 0:680].astype(np.float32)
+    smooth = 128 + 60 * np.sin(yy / 40.0)[..., None] \
+        + 50 * np.cos(xx / 55.0)[..., None] * np.array([1.0, 0.5, -0.5])
+    big = np.clip(smooth + rng.normal(0, 8, (512, 680, 3)),
+                  0, 255).astype(np.uint8)
+    small = decode_and_resize(encode_jpeg(big), 128)
+    assert small.shape[0] == 128 and small.dtype == np.uint8
+    ref = _aspect_preserving_resize(decode_jpeg(encode_jpeg(big)), 128)
+    assert small.shape == ref.shape
+    # same image content modulo interpolation path: strong pixel correlation
+    a = small.astype(np.float32).ravel()
+    b = ref.astype(np.float32).ravel()
+    corr = np.corrcoef(a, b)[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_imagenet_iterator_uint8_device_standardize(tmp_path):
+    """device_standardize=True train batches are raw uint8 crops; applying
+    the device vgg_standardize reproduces the host float path's range."""
+    d, total = _write_fake_imagenet(tmp_path)
+    it = imagenet_iterator(d, batch_size=4, mode="train", image_size=32,
+                           num_decode_threads=2, shuffle_buffer=4,
+                           device_standardize=True)
+    b = next(it)
+    assert b["images"].dtype == np.uint8
+    from distributed_resnet_tensorflow_tpu.ops.augment import vgg_standardize
+    out = np.asarray(vgg_standardize(b["images"], None))
+    assert out.dtype == np.float32
+    assert out.min() >= -1.0 and out.max() <= 1.0
+    # exact parity with the host-side standardization formula
+    from distributed_resnet_tensorflow_tpu.data.preprocessing import RGB_MEANS
+    want = b["images"].astype(np.float32) / 255.0 - RGB_MEANS
+    np.testing.assert_allclose(out, want, atol=1e-6)
+    # eval stays float (no device hook on the eval step)
+    _write_fake_imagenet(tmp_path, mode="validation")
+    it_ev = imagenet_iterator(d, batch_size=4, mode="eval", image_size=32,
+                              device_standardize=True)
+    assert next(it_ev)["images"].dtype == np.float32

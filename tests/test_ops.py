@@ -76,3 +76,87 @@ def test_mesh_axis_zero_collapses():
     from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
     sizes = resolve_axis_sizes(MeshConfig(data=-1, tensor=0), 8)
     assert sizes == (1, 8, 1, 1, 1, 1)
+
+
+def test_stat_subsample_matches_band_moments():
+    """stat_subsample=s must normalize with EXACTLY the moments of the
+    center band of H/s rows (and store them as running stats)."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 6, 6, 4).astype(np.float32) * 2 + 3)
+    m = GroupedBatchNorm(dtype=jnp.float32, stat_subsample=2, momentum=0.0)
+    y, _, stats = _apply(m, x)
+    xs = np.asarray(x)[:, 1:4, :, :]  # h=6, band=3 rows, lo=(6-3)//2=1
+    want_mean = xs.mean((0, 1, 2))
+    want_var = xs.var((0, 1, 2))
+    np.testing.assert_allclose(np.asarray(stats["mean"]), want_mean,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats["var"]), want_var, atol=1e-5)
+    want_y = (np.asarray(x) - want_mean) / np.sqrt(want_var + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), want_y, rtol=1e-4, atol=1e-4)
+
+
+def test_stat_subsample_close_to_exact_and_grouped():
+    """On iid data the band estimate tracks the exact moments (large-sample
+    sanity: the training-numerics drift is the estimator variance),
+    including under groups>1."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(32, 16, 16, 8).astype(np.float32) * 1.7 - 0.4)
+    y_exact, _, _ = _apply(GroupedBatchNorm(dtype=jnp.float32), x)
+    y_sub, _, _ = _apply(
+        GroupedBatchNorm(dtype=jnp.float32, stat_subsample=2), x)
+    np.testing.assert_allclose(np.asarray(y_sub), np.asarray(y_exact),
+                               rtol=0.1, atol=0.05)
+    yg_exact, _, _ = _apply(GroupedBatchNorm(dtype=jnp.float32, groups=2), x)
+    yg_sub, _, _ = _apply(
+        GroupedBatchNorm(dtype=jnp.float32, groups=2, stat_subsample=2), x)
+    np.testing.assert_allclose(np.asarray(yg_sub), np.asarray(yg_exact),
+                               rtol=0.1, atol=0.08)
+
+
+def test_band_stat_bn_gradients_are_exact():
+    """Autodiff of the band-stat forward == the analytic BN gradient with
+    band-restricted through-stats terms: dx_j = a·(dy_j − 1_band(j)·(dβ +
+    x̂_j·dγ)/|band|), dγ = Σ_all dy·x̂, dβ = Σ_all dy."""
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(4, 8, 6, 5).astype(np.float32) * 1.5 + 0.7)
+    scale = jnp.asarray(rng.rand(5).astype(np.float32) + 0.5)
+    bias = jnp.asarray(rng.randn(5).astype(np.float32))
+    w = jnp.asarray(rng.randn(*x.shape).astype(np.float32))  # loss weights
+    eps, sub = 1e-5, 2
+    h = x.shape[1]
+    bh = h // sub
+    lo = (h - bh) // 2
+
+    def fwd(x, s, b):
+        xs = x[:, lo:lo + bh]
+        mean = jnp.mean(xs, axis=(0, 1, 2))
+        var = jnp.mean(jnp.square(xs), axis=(0, 1, 2)) - jnp.square(mean)
+        return ((x - mean) * jax.lax.rsqrt(var + eps)) * s + b
+
+    gx, gs, gb = jax.grad(lambda *a: jnp.sum(fwd(*a) * w),
+                          argnums=(0, 1, 2))(x, scale, bias)
+    # analytic
+    xs = np.asarray(x)[:, lo:lo + bh]
+    mean = xs.mean((0, 1, 2))
+    var = xs.var((0, 1, 2))
+    inv = 1.0 / np.sqrt(var + eps)
+    xhat = (np.asarray(x) - mean) * inv
+    dy = np.asarray(w)
+    dbeta = dy.sum((0, 1, 2))
+    dgamma = (dy * xhat).sum((0, 1, 2))
+    n = x.shape[0] * bh * x.shape[2]
+    corr = (dbeta + xhat * dgamma) / n
+    band = np.zeros((1, h, 1, 1)); band[:, lo:lo + bh] = 1.0
+    want_dx = np.asarray(scale) * inv * (dy - band * corr)
+    np.testing.assert_allclose(np.asarray(gs), dgamma, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gb), dbeta, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gx), want_dx, rtol=2e-4, atol=2e-4)
+
+
+def test_stat_subsample_ignored_on_2d():
+    """(N, C) inputs have no spatial lattice — subsample must be a no-op."""
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    y1, _, _ = _apply(GroupedBatchNorm(dtype=jnp.float32), x)
+    y2, _, _ = _apply(GroupedBatchNorm(dtype=jnp.float32, stat_subsample=4), x)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y1), atol=1e-6)

@@ -1,0 +1,112 @@
+"""Generate a structured, learnable dataset in ImageNet TFRecord format.
+
+No-egress stand-in for the real ImageNet shards (same role as
+make_synth_cifar.py for the CIFAR path): JPEG-encoded tf.train.Examples in
+train-{i:05d}-of-{N} / validation-{i:05d}-of-{M} shards with the exact
+feature schema the reference's record_parser consumed
+(reference resnet_imagenet_main.py:115-136: image/encoded +
+image/class/label, labels 1-based with 0 = background).
+
+Learnability must survive the VGG train augmentation (random resize of the
+shorter side to [256,512] → random 224² crop → flip → constant-mean
+subtraction, reference vgg_preprocessing.py:284-314). Scale-coded textures
+(spatial frequency) do NOT survive the 2× random rescale, so the class
+signal here is geometry-free: each class adds a class-specific RGB direction
+(points on a color circle) on top of shared low-frequency clutter and heavy
+pixel noise. Mean color is invariant to resize/crop/flip, and VGG
+preprocessing subtracts fixed channel means — per-image statistics pass
+through — so the signal reaches the network intact while still requiring
+learning through the noise (and through JPEG compression).
+
+Image sizes are drawn from realistic ImageNet-ish dimensions so the decode
+and resize cost of benchmarking against these shards matches the real
+pipeline's work profile.
+
+Usage: python tools/make_synth_imagenet.py out_dir [--classes 16]
+           [--train-per-class 128] [--val-per-class 16] [--shards 8]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_resnet_tensorflow_tpu.data.preprocessing import encode_jpeg
+from distributed_resnet_tensorflow_tpu.data.tfrecord import (
+    build_example, write_tfrecords)
+
+# ImageNet-ish source dimensions (h, w) to draw from — mix of landscape,
+# portrait and square so the aspect-preserving resize path is exercised
+SOURCE_DIMS = [(375, 500), (500, 375), (333, 500), (500, 500),
+               (400, 300), (300, 400), (480, 640), (256, 256)]
+
+
+def class_color(cls: int, num_classes: int) -> np.ndarray:
+    """Unit RGB direction for a class: points on a color circle in the
+    plane orthogonal to luminance (so classes differ in hue, not
+    brightness — JPEG preserves hue well at quality 90)."""
+    theta = 2 * np.pi * cls / num_classes
+    u = np.asarray([1.0, -0.5, -0.5]) / np.sqrt(1.5)   # R vs GB
+    v = np.asarray([0.0, 1.0, -1.0]) / np.sqrt(2.0)    # G vs B
+    return np.cos(theta) * u + np.sin(theta) * v
+
+
+def make_image(cls: int, num_classes: int,
+               rng: np.random.RandomState) -> np.ndarray:
+    h, w = SOURCE_DIMS[rng.randint(len(SOURCE_DIMS))]
+    # shared clutter: a few random low-frequency gratings (class-independent)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    clutter = np.zeros((h, w), np.float32)
+    for _ in range(3):
+        fy, fx = rng.uniform(-0.02, 0.02, 2)
+        clutter += np.cos(2 * np.pi * (fy * yy + fx * xx)
+                          + rng.uniform(0, 2 * np.pi))
+    img = 118.0 + 20.0 * clutter[..., None] * rng.uniform(0.5, 1.0, 3)
+    img = img + 26.0 * class_color(cls, num_classes)       # the signal
+    img = img + rng.normal(0, 30.0, (h, w, 3))             # pixel noise
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def write_split(out_dir: str, prefix: str, num_shards: int, total_shards: int,
+                num_classes: int, per_class: int, seed: int) -> None:
+    rng = np.random.RandomState(seed)
+    # labels are 1-based (0 = background) like the reference's shards
+    labels = np.repeat(np.arange(1, num_classes + 1), per_class)
+    rng.shuffle(labels)
+    shards = np.array_split(labels, num_shards)
+    for i, shard_labels in enumerate(shards):
+        recs = []
+        for label in shard_labels:
+            img = make_image(int(label) - 1, num_classes, rng)
+            recs.append(build_example({
+                "image/encoded": [encode_jpeg(img)],
+                "image/class/label": [int(label)],
+            }))
+        name = f"{prefix}-{i:05d}-of-{total_shards:05d}"
+        write_tfrecords(os.path.join(out_dir, name), recs)
+        print(f"wrote {name} ({len(recs)} examples)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir")
+    ap.add_argument("--classes", type=int, default=16)
+    ap.add_argument("--train-per-class", type=int, default=128)
+    ap.add_argument("--val-per-class", type=int, default=16)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+    write_split(args.out_dir, "train", args.shards, args.shards,
+                args.classes, args.train_per_class, args.seed)
+    write_split(args.out_dir, "validation", max(1, args.shards // 4),
+                max(1, args.shards // 4),
+                args.classes, args.val_per_class, args.seed + 1)
+
+
+if __name__ == "__main__":
+    main()

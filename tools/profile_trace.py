@@ -1,0 +1,103 @@
+"""Per-op TPU profile of the ImageNet ResNet-50 train step.
+
+Captures a jax.profiler trace of the fused train dispatch and converts the
+xplane via tensorboard_plugin_profile into an HLO-op time breakdown — the
+auditable evidence behind docs/perf_imagenet_r3.md (the reference kept its
+perf story in README tables; this is the TPU analog with per-op receipts).
+
+    python tools/profile_trace.py [--bs 128] [--k 8] [--sub 1] [--top 25]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def capture(bs: int, k: int, sub: int, logdir: str):
+    from profile_imagenet_bn import build_step
+    trainer, multi_fn, batch, _one = build_step(bs, k, stat_subsample=sub)
+    state = trainer.state
+    for _ in range(2):  # compile + warm
+        state, _ = multi_fn(state, batch)
+    jax.block_until_ready(state.params)
+    with jax.profiler.trace(logdir):
+        for _ in range(2):
+            state, _ = multi_fn(state, batch)
+        jax.block_until_ready(state.params)
+
+
+def op_table(logdir: str, top: int):
+    """xplane → [(op name, category, self_time_us, occurrences)] sorted."""
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+    xplanes = glob.glob(os.path.join(
+        logdir, "plugins/profile/*/*.xplane.pb"))
+    if not xplanes:
+        raise FileNotFoundError(f"no xplane under {logdir}")
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [xplanes[-1]], "hlo_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    payload = json.loads(data)
+    # hlo_stats: a GViz table; rows of [..columns..]
+    cols = [c["label"] for c in payload[0]["cols"]] \
+        if isinstance(payload, list) else [c["label"] for c in payload["cols"]]
+    rows = payload[0]["rows"] if isinstance(payload, list) else payload["rows"]
+
+    def col(name):
+        for i, c in enumerate(cols):
+            if name.lower() in c.lower():
+                return i
+        return None
+    i_cat = col("category")
+    i_name = col("HLO op name") or col("name")
+    i_self = col("Total self time (us)") or col("self time")
+    i_occ = col("occurrences")
+    out = []
+    for r in rows:
+        c = [x.get("v") if isinstance(x, dict) else x for x in r["c"]]
+        out.append({
+            "category": c[i_cat] if i_cat is not None else "",
+            "op": c[i_name] if i_name is not None else "",
+            "self_us": float(c[i_self] or 0) if i_self is not None else 0.0,
+            "n": c[i_occ] if i_occ is not None else "",
+        })
+    out.sort(key=lambda d: -d["self_us"])
+    return cols, out[:top]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=128)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--sub", type=int, default=1)
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--logdir", default="/tmp/drt_trace")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    capture(args.bs, args.k, args.sub, args.logdir)
+    cols, table = op_table(args.logdir, args.top)
+    total = sum(d["self_us"] for d in table)
+    print(f"top-{args.top} HLO ops by self time "
+          f"(bs={args.bs}, k={args.k}, stat_subsample={args.sub}):")
+    for d in table:
+        print(f"{d['self_us']:>10.0f} us  {d['category']:<22} "
+              f"{str(d['op'])[:70]}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"bs": args.bs, "k": args.k, "sub": args.sub,
+                       "table": table}, f, indent=2)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
