@@ -40,15 +40,19 @@ _NEG_INF = -1e30
 BLOCK_Q = 256
 BLOCK_K = 256
 
-# block table (tools/tune_flash_attention.py measures on TPU; bf16 fwd+bwd
-# grad time): seq-length buckets → (block_q, block_k). NOTE an early guess
-# of wider k-blocks (256×512 at T=4096) measured 1.8× SLOWER than 256×256
-# (15.8 vs 8.8 ms) — entries here must come from the tuner, never intuition.
+# block table from tools/tune_flash_attention.py on TPU v5e (bf16, causal,
+# fwd+bwd grad time over the full {128,256,512}² grid at T ∈ 1k..8k, d=64 —
+# docs/flash_tune_r3.json): each bucket carries its measured winner (e.g.
+# T=4096: 512×512 at 11.9 ms vs 14.9 for the old 256×256 guess; T=8192:
+# 12.5 ms vs dense 126.7 → 10.1×). d=128 is unmeasured and inherits these
+# tiles (VMEM still fits comfortably). Entries must come from the tuner,
+# never intuition — an early guessed 256×512 row measured 1.8× slower than
+# what it replaced.
 _BLOCK_TABLE = (
-    (1024, (256, 256)),
-    (2048, (256, 256)),
-    (4096, (256, 256)),
-    (8192, (256, 256)),
+    (1024, (512, 512)),
+    (2048, (128, 512)),
+    (4096, (512, 512)),
+    (8192, (512, 512)),
 )
 
 

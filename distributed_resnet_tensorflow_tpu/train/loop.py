@@ -108,10 +108,12 @@ def make_train_step(schedule: Callable, weight_decay: float,
     if ce_fn is None:
         ce_fn = make_ce_fn(label_smoothing)
 
-    def prep(images, step):
+    def prep(images, step, midx=None):
         if augment_fn is None:
             return images
         rng = jax.random.fold_in(jax.random.PRNGKey(augment_seed), step)
+        if midx is not None:  # distinct draws per accumulation microbatch
+            rng = jax.random.fold_in(rng, midx)
         return augment_fn(images, rng)
 
     def loss_fn(params, batch_stats, images, labels, apply_fn):
@@ -153,8 +155,13 @@ def make_train_step(schedule: Callable, weight_decay: float,
     def accum_step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
         """lax.scan over microbatches: grads averaged, BN stats from the last
         microbatch (the reference had no accumulation; this enables reference
-        global-batch parity on few chips)."""
-        images, labels = prep(batch["images"], state.step), batch["labels"]
+        global-batch parity on few chips).
+
+        Augmentation/standardization runs INSIDE the scan body, one
+        microbatch at a time — prepping the whole global batch up front
+        would materialize it in float32 (at gbs 32k × 224² that is ~20 GB,
+        more than a chip's HBM; the uint8 input is 4×-8× smaller)."""
+        images, labels = batch["images"], batch["labels"]
         n = grad_accum_steps
         mb = images.shape[0] // n
         images = images.reshape((n, mb) + images.shape[1:])
@@ -162,7 +169,8 @@ def make_train_step(schedule: Callable, weight_decay: float,
 
         def body(carry, xs):
             grads_acc, ce_acc, prec_acc, bs = carry
-            im, lb = xs
+            im, lb, midx = xs
+            im = prep(im, state.step, midx)
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
             (loss, (ce, logits, new_bs)), grads = grad_fn(
                 state.params, bs, im, lb, state.apply_fn)
@@ -173,7 +181,8 @@ def make_train_step(schedule: Callable, weight_decay: float,
         zero_grads = jax.tree_util.tree_map(
             lambda p: jnp.zeros_like(p, jnp.float32), state.params)
         (grads, ce_sum, prec_sum, new_bs), losses = jax.lax.scan(
-            body, (zero_grads, 0.0, 0.0, state.batch_stats), (images, labels))
+            body, (zero_grads, 0.0, 0.0, state.batch_stats),
+            (images, labels, jnp.arange(n)))
         grads = jax.tree_util.tree_map(lambda g: g / n, grads)
         new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
         metrics = {
