@@ -121,12 +121,38 @@ def bench_cifar():
         streamed_steps_per_sec = max(streamed_steps_per_sec,
                                      n_s / (time.perf_counter() - t0))
 
+    # (c) the streamed path's decomposition, so the number above is
+    # attributable: the host-side pipeline alone (draw raw-uint8 batches,
+    # no device), and the raw host→device transfer bandwidth at the
+    # stacked-group granularity. On this machine the device link is a
+    # remote tunnel (MB/s, swings several×) — the streamed rate IS the
+    # transfer rate; a TPU-VM's PCIe moves the same batches ~1000× faster.
+    it2 = create_input_iterator(cfg, mode="train")
+    next(it2)
+    t0 = time.perf_counter()
+    n_h = 300
+    for _ in range(n_h):
+        next(it2)
+    host_only = n_h / (time.perf_counter() - t0)
+    import jax.numpy as jnp
+    blob = np.random.RandomState(1).randint(
+        0, 256, 8 * 10 ** 6, dtype=np.uint8)
+    jax.device_put(blob).block_until_ready()
+    best_put = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        y = jax.device_put(blob)
+        float(jnp.sum(y[:8].astype(jnp.float32)))  # fence via host pull
+        best_put = min(best_put, time.perf_counter() - t0)
+
     return {
         "steps_per_sec": round(steps_per_sec, 2),
         "mfu": round(util, 4) if util else None,
         "real_input_steps_per_sec": round(real_steps_per_sec, 2),
         "real_vs_synthetic": round(real_steps_per_sec / steps_per_sec, 3),
         "streamed_input_steps_per_sec": round(streamed_steps_per_sec, 2),
+        "streamed_host_only_batches_per_sec": round(host_only, 1),
+        "device_put_MBps": round(8.0 / best_put, 1),
     }
 
 
@@ -271,37 +297,41 @@ def bench_imagenet():
     raise RuntimeError(f"no ImageNet batch size fit: {last_err}")
 
 
+def attention_grad_ms(attn_fn, q, k, v, iters=10, reps=3):
+    """ms per fwd+bwd of ``attn_fn`` timed inside a lax.scan (the remote-
+    tunnel dispatch floor would swamp per-call timing), fenced through a
+    host transfer (on the tunneled backend block_until_ready can return
+    before compute finishes). The ONE measurement harness shared by this
+    bench and tools/tune_flash_attention.py — methodology fixes land once."""
+    import jax.numpy as jnp
+    g = jax.grad(lambda q, k, v: attn_fn(q, k, v)
+                 .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+
+    @jax.jit
+    def run(q, k, v):
+        def body(qq, _):
+            dq, dk, dv = g(qq, k, v)
+            return qq + 1e-6 * dq.astype(qq.dtype), ()
+        return jax.lax.scan(body, q, None, length=iters)[0]
+
+    float(jnp.sum(run(q, k, v).astype(jnp.float32)))  # compile + fence
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(q, k, v)
+        float(jnp.sum(out.astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / iters * 1000)
+    return best
+
+
 def bench_flash_attention(iters=10):
     """Long-context attention: fused Pallas flash (fwd+bwd kernels, tuned
-    512×512 tiles — docs/flash_tune_r3.json) vs XLA dense autodiff, causal
-    bf16, at the 4k crossover regime and the 8k regime where dense's O(T²)
-    memory collapses. Timed inside a lax.scan (the remote-tunnel dispatch
-    floor would swamp per-call timing)."""
+    tiles — docs/flash_tune_r3.json) vs XLA dense autodiff, causal bf16, at
+    the 4k crossover regime and the 8k regime where dense's O(T²) memory
+    collapses."""
     import jax.numpy as jnp
     from distributed_resnet_tensorflow_tpu.ops.attention import attention
     from distributed_resnet_tensorflow_tpu.ops.pallas import flash_attention
-
-    def grad_scan(attn_fn):
-        g = jax.grad(lambda q, k, v: attn_fn(q, k, v)
-                     .astype(jnp.float32).sum(), argnums=(0, 1, 2))
-
-        @jax.jit
-        def run(q, k, v):
-            def body(qq, _):
-                dq, dk, dv = g(qq, k, v)
-                return qq + 1e-6 * dq.astype(qq.dtype), ()
-            return jax.lax.scan(body, q, None, length=iters)[0]
-        return run
-
-    def timeit(run, q, k, v):
-        float(jnp.sum(run(q, k, v).astype(jnp.float32)))  # compile + fence
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = run(q, k, v)
-            float(jnp.sum(out.astype(jnp.float32)))
-            best = min(best, (time.perf_counter() - t0) / iters * 1000)
-        return best
 
     out = {}
     rng = np.random.RandomState(0)
@@ -309,10 +339,11 @@ def bench_flash_attention(iters=10):
         # attention FLOPs (∝ h·T²·d) still double at 8k
         q, k, v = (jnp.asarray(rng.randn(1, t, h, 64).astype(np.float32))
                    .astype(jnp.bfloat16) for _ in range(3))
-        fused = timeit(grad_scan(
-            lambda q, k, v: flash_attention(q, k, v, True, False)), q, k, v)
-        dense = timeit(grad_scan(
-            lambda q, k, v: attention(q, k, v, causal=True)), q, k, v)
+        fused = attention_grad_ms(
+            lambda q, k, v: flash_attention(q, k, v, True, False),
+            q, k, v, iters)
+        dense = attention_grad_ms(
+            lambda q, k, v: attention(q, k, v, causal=True), q, k, v, iters)
         out[f"T{t}"] = {"fused_grad_ms": round(fused, 2),
                         "dense_grad_ms": round(dense, 2),
                         "speedup": round(dense / fused, 2)}

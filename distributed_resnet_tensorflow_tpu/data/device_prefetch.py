@@ -117,7 +117,12 @@ def threaded_iterator(src: Iterator, depth: int = 2,
             q.get_nowait()
         except queue_mod.Empty:
             pass
-        thread.join(timeout=1.0)
+        try:
+            thread.join(timeout=1.0)
+        except TypeError:
+            # interpreter teardown: a GC'd generator can land here after
+            # threading internals are already None'd out
+            pass
         close = getattr(src, "close", None)
         if close is not None:
             try:

@@ -1,5 +1,5 @@
-"""Mixture-of-Experts MLP (Switch-style top-1 routing) — the consumer of the
-``expert`` mesh axis.
+"""Mixture-of-Experts MLP (Switch top-1 / GShard-style top-2 routing) — the
+consumer of the ``expert`` mesh axis.
 
 The reference is a dense-only trainer (SURVEY.md §2.10); this completes the
 6-axis mesh so every axis has a model consumer. Design (Switch Transformer
@@ -8,13 +8,26 @@ recipe, scoped to what the ViT family needs):
   * E expert MLPs with stacked parameters (E, D, F)/(E, F, D), sharded over
     the ``expert`` axis by parallel/sharding.py's rule — each device group
     holds E/expert_axis experts (and their optimizer moments).
-  * Top-1 routing with probability gating and a fixed per-expert capacity
-    ``ceil(tokens/E · capacity_factor)``; over-capacity tokens fall through
-    on the residual path (standard Switch behavior).
-  * Dispatch/combine are one-hot einsums — GSPMD partitions them over the
-    sharded expert dimension and inserts the token exchange collectives.
-    This is the sharding-first formulation (no hand-written all-to-all);
-    optimal a2a scheduling is left to XLA.
+  * Top-1 (Switch) or top-2 (GShard-style) routing with probability gating
+    and a fixed per-expert capacity ``ceil(top_k · tokens/E ·
+    capacity_factor)``; over-capacity tokens fall through on the residual
+    path. Top-2 normalizes the two gates over the selected pair and gives
+    first choices capacity priority over second choices (the GShard
+    ordering: a token's backup never displaces another token's primary).
+  * Two dispatch formulations, selected by ``dispatch``:
+      - "einsum": one-hot (N, E, C) dispatch/combine einsums — GSPMD
+        partitions them over the sharded expert dimension and inserts the
+        token-exchange collectives (the sharding-first formulation; no
+        hand-written all-to-all). Cost: the one-hot tensors are O(N·E·C)
+        HBM — measured 2.46× a dense MLP step at 8k tokens × 8 experts
+        (docs/moe_r3.json).
+      - "gather": scatter the kept token ids into an (E·C,) slot table,
+        gather expert inputs by slot, gather combines back per token —
+        O(N + E·C) memory, no one-hot tensors at all.
+    "auto" uses gather when the expert dim is NOT mesh-sharded and einsum
+    when it is (scatters across a sharded dim would make GSPMD all-gather
+    the slot table; the einsum form keeps the exchange a clean a2a). The
+    two are exact-parity tested against each other.
   * The Switch load-balancing auxiliary loss (E · Σ_e fraction_e · prob_e)
     is sown into the ``losses`` collection; the train step adds every sown
     loss scaled by ``model.moe_aux_weight`` (train/loop.py).
@@ -38,6 +51,8 @@ class SwitchMlp(nn.Module):
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
     mesh: Any = None
+    top_k: int = 1
+    dispatch: str = "auto"  # auto | einsum | gather (module docstring)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -45,8 +60,13 @@ class SwitchMlp(nn.Module):
         e = self.num_experts
         f = self.mlp_ratio * d
         n_tokens = b * t
+        if self.top_k not in (1, 2) or self.top_k > e:
+            raise ValueError(
+                f"moe top_k must be 1 or 2 and <= num_experts={e}, "
+                f"got {self.top_k}")
         import math
-        capacity = max(1, math.ceil((n_tokens / e) * self.capacity_factor))
+        capacity = max(1, math.ceil(
+            self.top_k * (n_tokens / e) * self.capacity_factor))
 
         vs = jax.nn.initializers.variance_scaling
         w1 = self.param("w1", vs(1.0, "fan_in", "truncated_normal",
@@ -66,40 +86,102 @@ class SwitchMlp(nn.Module):
             x.astype(jnp.float32))                       # (B, T, E)
         probs = jax.nn.softmax(logits, axis=-1)
         flat_probs = probs.reshape(n_tokens, e)
-        expert_idx = jnp.argmax(flat_probs, axis=-1)     # (N,)
-        gate = jnp.max(flat_probs, axis=-1)              # (N,)
+        expert_idx = jnp.argmax(flat_probs, axis=-1)     # (N,) first choice
+        gate1 = jnp.max(flat_probs, axis=-1)             # (N,)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
 
         # Switch aux loss: E * Σ_e (fraction of tokens routed to e) · (mean
         # router prob of e) — pushes the router toward uniform utilization
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        # (first-choice fractions in both routing modes, the Switch form)
         fraction = onehot.mean(axis=0)
         mean_prob = flat_probs.mean(axis=0)
         self.sow("losses", "moe_aux", e * jnp.sum(fraction * mean_prob))
 
-        # --- capacity assignment ------------------------------------------
-        # position of each token within its expert's queue; >= capacity drops
-        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (N, E)
-        pos = jnp.sum(pos_in_expert, axis=-1).astype(jnp.int32)      # (N,)
-        keep = pos < capacity
-        gate = gate * keep.astype(jnp.float32)
+        if self.top_k == 2:
+            # second choice: argmax with the first masked out; gates
+            # renormalized over the selected pair (GShard)
+            masked = flat_probs - onehot * 2.0  # probs ∈ [0,1]: -2 loses
+            expert_idx2 = jnp.argmax(masked, axis=-1)
+            gate2 = jnp.take_along_axis(
+                flat_probs, expert_idx2[:, None], axis=-1)[:, 0]
+            denom = gate1 + gate2
+            waves = [(expert_idx, gate1 / denom), (expert_idx2, gate2 / denom)]
+        else:
+            waves = [(expert_idx, gate1)]
 
-        # dispatch: (N, E, C) one-hot — token n feeds slot (expert, pos)
-        dispatch = (onehot[:, :, None]
-                    * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :]
-                    * keep[:, None, None].astype(jnp.float32))
-        combine = dispatch * gate[:, None, None]
+        # --- capacity assignment ------------------------------------------
+        # per-expert queue positions; wave 2 queues BEHIND wave 1 (first
+        # choices have priority); >= capacity drops that assignment
+        assigned = []                      # (idx, gate, pos, keep) per wave
+        base_counts = jnp.zeros((e,), jnp.float32)
+        for idx_k, gate_k in waves:
+            oh = jax.nn.one_hot(idx_k, e, dtype=jnp.float32)     # (N, E)
+            pos_in_expert = (jnp.cumsum(oh, axis=0) - 1.0) * oh  # (N, E)
+            pos = (jnp.sum(pos_in_expert, axis=-1)
+                   + oh @ base_counts).astype(jnp.int32)         # (N,)
+            keep = pos < capacity
+            assigned.append((idx_k, gate_k * keep.astype(jnp.float32),
+                             pos, keep))
+            base_counts = base_counts + oh.sum(axis=0)
+
+        mode = self.dispatch
+        if mode == "auto":
+            sharded_e = (self.mesh is not None
+                         and self.mesh.shape.get("expert", 1) > 1)
+            mode = "einsum" if sharded_e else "gather"
+        if mode not in ("einsum", "gather"):
+            raise ValueError(f"unknown moe dispatch mode {mode!r}")
 
         flat_x = x.reshape(n_tokens, d)
-        # expert inputs (E, C, D): GSPMD shards the E dim over `expert`
+
+        def expert_mlp(ein):
+            """(E, C, D) expert inputs → (E, C, D) outputs."""
+            h = jnp.einsum("ecd,edf->ecf", ein, w1.astype(self.dtype)) \
+                + b1[:, None, :].astype(self.dtype)
+            h = nn.gelu(h)
+            return jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype)) \
+                + b2[:, None, :].astype(self.dtype)
+
+        if mode == "gather":
+            # slot table: kept token n occupies slot idx·C + pos. Dropped
+            # assignments write out of bounds (mode="drop"); empty slots
+            # keep the sentinel n_tokens, which gathers the appended zero
+            # row. O(N + E·C) memory — no (N, E, C) tensors anywhere.
+            nslots = e * capacity
+            sel = jnp.full((nslots,), n_tokens, jnp.int32)
+            for idx_k, _gate, pos_k, keep_k in assigned:
+                slot = idx_k * capacity + pos_k
+                slot = jnp.where(keep_k, slot, nslots)
+                sel = sel.at[slot].set(jnp.arange(n_tokens, dtype=jnp.int32),
+                                       mode="drop")
+            padded = jnp.concatenate(
+                [flat_x.astype(self.dtype),
+                 jnp.zeros((1, d), self.dtype)], axis=0)
+            ein = jnp.take(padded, sel, axis=0).reshape(e, capacity, d)
+            eout = expert_mlp(ein).reshape(nslots, d)
+            out = jnp.zeros((n_tokens, d), self.dtype)
+            for idx_k, gate_k, pos_k, _keep in assigned:
+                slot = jnp.clip(idx_k * capacity + pos_k, 0, nslots - 1)
+                out = out + gate_k[:, None].astype(self.dtype) \
+                    * jnp.take(eout, slot, axis=0)
+            return out.reshape(b, t, d)
+
+        # one-hot einsum dispatch (GSPMD shards the E dim over `expert`)
+        dispatch = jnp.zeros((n_tokens, e, capacity), jnp.float32)
+        combine = jnp.zeros((n_tokens, e, capacity), jnp.float32)
+        for idx_k, gate_k, pos_k, keep_k in assigned:
+            oh = jax.nn.one_hot(idx_k, e, dtype=jnp.float32)
+            d_k = (oh[:, :, None]
+                   * jax.nn.one_hot(pos_k, capacity,
+                                    dtype=jnp.float32)[:, None, :]
+                   * keep_k[:, None, None].astype(jnp.float32))
+            dispatch = dispatch + d_k
+            combine = combine + d_k * gate_k[:, None, None]
+
         ein = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype),
                          flat_x.astype(self.dtype))
         ein = self._constrain_e(ein)
-        h = jnp.einsum("ecd,edf->ecf", ein, w1.astype(self.dtype)) \
-            + b1[:, None, :].astype(self.dtype)
-        h = nn.gelu(h)
-        eout = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype)) \
-            + b2[:, None, :].astype(self.dtype)
-        eout = self._constrain_e(eout)
+        eout = self._constrain_e(expert_mlp(ein))
         out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), eout)
         return out.reshape(b, t, d)
 

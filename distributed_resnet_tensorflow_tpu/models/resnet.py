@@ -340,7 +340,9 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
             attention_impl=attn, remat=remat, mesh=mesh,
             pipeline_microbatches=model_cfg.vit_pipeline_microbatches,
             num_experts=model_cfg.vit_num_experts,
-            expert_capacity_factor=model_cfg.vit_expert_capacity_factor)
+            expert_capacity_factor=model_cfg.vit_expert_capacity_factor,
+            moe_top_k=model_cfg.vit_moe_top_k,
+            moe_dispatch=model_cfg.vit_moe_dispatch)
     if dataset in ("cifar10", "cifar100", "synthetic"):
         return CifarResNetV2(
             resnet_size=model_cfg.resnet_size,

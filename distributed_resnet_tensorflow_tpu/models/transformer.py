@@ -103,6 +103,8 @@ class EncoderBlock(nn.Module):
     mesh: Any = None
     num_experts: int = 0             # >0 → Switch MoE MLP (models/moe.py)
     expert_capacity_factor: float = 1.25
+    moe_top_k: int = 1
+    moe_dispatch: str = "auto"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -118,7 +120,8 @@ class EncoderBlock(nn.Module):
             return x + SwitchMlp(
                 num_experts=self.num_experts, mlp_ratio=self.mlp_ratio,
                 capacity_factor=self.expert_capacity_factor,
-                dtype=self.dtype, mesh=mesh)(h)
+                dtype=self.dtype, mesh=mesh, top_k=self.moe_top_k,
+                dispatch=self.moe_dispatch)(h)
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
         h = nn.gelu(h)
         if tensor > 1:
@@ -153,6 +156,8 @@ class VisionTransformer(nn.Module):
     pipeline_microbatches: int = 0  # 0 → 2 × pipeline stages
     num_experts: int = 0            # >0 → Switch MoE MLPs over `expert`
     expert_capacity_factor: float = 1.25
+    moe_top_k: int = 1
+    moe_dispatch: str = "auto"
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -209,6 +214,8 @@ class VisionTransformer(nn.Module):
                           self.attention_impl, mesh,
                           num_experts=self.num_experts,
                           expert_capacity_factor=self.expert_capacity_factor,
+                          moe_top_k=self.moe_top_k,
+                          moe_dispatch=self.moe_dispatch,
                           )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = x.mean(axis=1).astype(jnp.float32)

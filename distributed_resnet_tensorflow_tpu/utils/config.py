@@ -55,6 +55,9 @@ class ModelConfig:
     # (models/moe.py), shardable over mesh.expert
     vit_num_experts: int = 0
     vit_expert_capacity_factor: float = 1.25
+    vit_moe_top_k: int = 1            # 1 = Switch; 2 = GShard-style top-2
+    # auto = gather (O(N+EC)) off the expert mesh axis, one-hot einsum on it
+    vit_moe_dispatch: str = "auto"    # auto | einsum | gather
     moe_aux_weight: float = 0.01      # Switch load-balancing loss weight
     # auto = ring if mesh.sequence>1; flash on TPU at >=2048 tokens; else dense
     attention_impl: str = "auto"      # auto | dense | blockwise | flash | ring

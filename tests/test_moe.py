@@ -122,3 +122,167 @@ def test_expert_axis_requires_moe_model():
     cfg2.mesh.tensor = 2
     with pytest.raises(ValueError, match="tensor"):
         Trainer(cfg2)
+
+
+def test_top2_routing_combines_two_experts():
+    """top_k=2: the output is the gate-weighted mix of BOTH selected
+    experts' MLPs (checked against a direct per-token computation with
+    ample capacity so nothing drops)."""
+    import numpy as np
+    from distributed_resnet_tensorflow_tpu.models.moe import SwitchMlp
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 6, 16).astype(np.float32))
+    m = SwitchMlp(num_experts=4, mlp_ratio=2, capacity_factor=4.0,
+                  dtype=jnp.float32, top_k=2)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    y, _ = m.apply(variables, x, mutable=["losses"])
+
+    p = variables["params"]
+    router_w = np.asarray(p["router"]["kernel"])
+    router_b = np.asarray(p["router"]["bias"])
+    w1, b1 = np.asarray(p["w1"]), np.asarray(p["bias1"])
+    w2, b2 = np.asarray(p["w2"]), np.asarray(p["bias2"])
+    xf = np.asarray(x).reshape(-1, 16)
+    logits = xf @ router_w + router_b
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    order = np.argsort(-probs, axis=-1)
+    e1, e2 = order[:, 0], order[:, 1]
+    g1 = probs[np.arange(len(xf)), e1]
+    g2 = probs[np.arange(len(xf)), e2]
+    denom = g1 + g2
+
+    # use jax for the exact gelu the module uses
+    import flax.linen as fnn
+
+    def mlp_jax(e, v):
+        h = jnp.asarray(v) @ jnp.asarray(w1[e]) + jnp.asarray(b1[e])
+        h = fnn.gelu(h)
+        return np.asarray(h @ jnp.asarray(w2[e]) + jnp.asarray(b2[e]))
+
+    want = np.stack([
+        (g1[i] / denom[i]) * mlp_jax(e1[i], xf[i])
+        + (g2[i] / denom[i]) * mlp_jax(e2[i], xf[i])
+        for i in range(len(xf))])
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 16), want,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_top2_capacity_priority_first_choice_wins():
+    """First choices get capacity BEFORE any second choice: with capacity 1
+    and a crafted router — token0 prefers A then B, token1 prefers B then A
+    — each token must be served by its PRIMARY expert only (both backups
+    find their expert full). If the waves were processed backups-first, the
+    experts would swap (token0 ← B, token1 ← A), which this asserts against.
+    """
+    import numpy as np
+    import flax.linen as fnn
+    from distributed_resnet_tensorflow_tpu.models.moe import SwitchMlp
+    d = 8
+    x = np.zeros((1, 2, d), np.float32)
+    x[0, 0, 0] = 1.0   # token0
+    x[0, 1, 1] = 1.0   # token1
+    x = jnp.asarray(x)
+    # capacity = ceil(top_k * N/E * cf) = ceil(2*2/2 * 0.5) = 1
+    m = SwitchMlp(num_experts=2, mlp_ratio=2, capacity_factor=0.5,
+                  dtype=jnp.float32, top_k=2)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    p = jax.tree_util.tree_map(np.asarray, variables["params"])
+    # router: token0 → logits (2, 1) (A first, B second);
+    #         token1 → logits (1, 2) (B first, A second)
+    rk = np.zeros((d, 2), np.float32)
+    rk[0] = [2.0, 1.0]
+    rk[1] = [1.0, 2.0]
+    p["router"]["kernel"] = rk
+    p["router"]["bias"] = np.zeros(2, np.float32)
+    y, _ = m.apply({"params": jax.tree_util.tree_map(jnp.asarray, p)}, x,
+                   mutable=["losses"])
+    y = np.asarray(y)[0]
+
+    def expert(e, v):
+        h = np.asarray(fnn.gelu(
+            jnp.asarray(v @ p["w1"][e] + p["bias1"][e])))
+        return h @ p["w2"][e] + p["bias2"][e]
+
+    # gates renormalize over the pair: max prob / (max + second) per token
+    logits = np.asarray(x)[0] @ rk
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    g = probs.max(-1) / (probs[:, 0] + probs[:, 1])
+    want0 = g[0] * expert(0, np.asarray(x)[0, 0])   # token0 ← A only
+    want1 = g[1] * expert(1, np.asarray(x)[0, 1])   # token1 ← B only
+    np.testing.assert_allclose(y[0], want0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(y[1], want1, rtol=1e-5, atol=1e-6)
+    # and NOT the swapped (backups-first) assignment
+    swapped0 = g[0] * expert(1, np.asarray(x)[0, 0])
+    assert not np.allclose(y[0], swapped0, atol=1e-4)
+
+
+def test_top1_unchanged_by_top_k_field_default():
+    """Default top_k=1 reproduces the original Switch behavior exactly."""
+    import numpy as np
+    from distributed_resnet_tensorflow_tpu.models.moe import SwitchMlp
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 4, 8).astype(np.float32))
+    m1 = SwitchMlp(num_experts=2, mlp_ratio=2, dtype=jnp.float32)
+    m2 = SwitchMlp(num_experts=2, mlp_ratio=2, dtype=jnp.float32, top_k=1)
+    v = m1.init(jax.random.PRNGKey(0), x)
+    y1, _ = m1.apply(v, x, mutable=["losses"])
+    y2, _ = m2.apply(v, x, mutable=["losses"])
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_moe_top2_trains_through_trainer():
+    import numpy as np
+    from distributed_resnet_tensorflow_tpu.data import (
+        learnable_synthetic_iterator)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+    cfg = get_preset("smoke")
+    cfg.model.name = "vit"
+    cfg.model.num_classes = 4
+    cfg.model.compute_dtype = "float32"
+    cfg.model.vit_dim = 16
+    cfg.model.vit_depth = 2
+    cfg.model.vit_heads = 2
+    cfg.model.vit_num_experts = 4
+    cfg.model.vit_moe_top_k = 2
+    cfg.data.image_size = 8
+    cfg.train.batch_size = 8
+    cfg.mesh.data = 2
+    cfg.mesh.expert = 4
+    tr = Trainer(cfg)
+    tr.init_state()
+    state, m = tr.train(learnable_synthetic_iterator(8, 8, 4), num_steps=2)
+    assert int(state.step) == 2
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_gather_dispatch_matches_einsum():
+    """The O(N+EC) gather dispatch == the one-hot einsum dispatch exactly
+    (outputs AND gradients), for top-1 and top-2, with drops occurring."""
+    import numpy as np
+    from distributed_resnet_tensorflow_tpu.models.moe import SwitchMlp
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 8, 16).astype(np.float32))
+    for top_k in (1, 2):
+        for cf in (2.0, 0.5):  # ample capacity AND forced drops
+            me = SwitchMlp(num_experts=4, mlp_ratio=2, capacity_factor=cf,
+                           dtype=jnp.float32, top_k=top_k, dispatch="einsum")
+            mg = SwitchMlp(num_experts=4, mlp_ratio=2, capacity_factor=cf,
+                           dtype=jnp.float32, top_k=top_k, dispatch="gather")
+            v = me.init(jax.random.PRNGKey(0), x)
+
+            def loss(m):
+                def fn(params, x):
+                    y, _ = m.apply({"params": params}, x,
+                                   mutable=["losses"])
+                    return (y ** 2).sum()
+                return fn
+
+            le, ge = jax.value_and_grad(loss(me))(v["params"], x)
+            lg, gg = jax.value_and_grad(loss(mg))(v["params"], x)
+            assert np.isclose(float(le), float(lg), rtol=1e-5), (top_k, cf)
+            for a, b in zip(jax.tree_util.tree_leaves(ge),
+                            jax.tree_util.tree_leaves(gg)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5)

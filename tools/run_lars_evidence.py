@@ -126,7 +126,8 @@ def main():
         "finite": all(np.isfinite(r["loss"]) for r in rows),
         "rows": rows,
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    if os.path.dirname(args.out):
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
     print(f"\nce {ces[0]:.3f} -> {ces[-1]:.3f} over {args.steps} steps of "

@@ -32,27 +32,10 @@ HEAD_DIMS = (64, 128)
 
 
 def grad_time(attn_fn, q, k, v, iters=8, reps=3):
-    """ms per fwd+bwd, timed inside a lax.scan (dispatch-floor immune)."""
-    g = jax.grad(lambda q, k, v: attn_fn(q, k, v)
-                 .astype(jnp.float32).sum(), argnums=(0, 1, 2))
-
-    @jax.jit
-    def run(q, k, v):
-        def body(qq, _):
-            dq, dk, dv = g(qq, k, v)
-            return qq + 1e-6 * dq.astype(qq.dtype), ()
-        return jax.lax.scan(body, q, None, length=iters)[0]
-
-    # force a host transfer to fence the timing: on the remote (tunneled)
-    # backend block_until_ready can return before compute finishes, which
-    # silently times dispatch instead of the kernel
-    float(jnp.sum(run(q, k, v).astype(jnp.float32)))
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        float(jnp.sum(run(q, k, v).astype(jnp.float32)))
-        best = min(best, (time.perf_counter() - t0) / iters * 1000)
-    return best
+    """One shared harness with the bench (bench.attention_grad_ms) so the
+    tuner's numbers and the bench's stay methodologically identical."""
+    from bench import attention_grad_ms
+    return attention_grad_ms(attn_fn, q, k, v, iters, reps)
 
 
 def main():
@@ -108,7 +91,8 @@ def main():
                   f"({point['blocks'][best]} ms) vs dense {point['dense_ms']}"
                   f" ms -> {point['speedup_vs_dense']}x", flush=True)
             results.append(point)
-            os.makedirs(os.path.dirname(args.out), exist_ok=True)
+            if os.path.dirname(args.out):
+                os.makedirs(os.path.dirname(args.out), exist_ok=True)
             with open(args.out, "w") as f:
                 json.dump(out, f, indent=2)
     print(f"wrote {args.out}")
