@@ -199,17 +199,29 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
 
     d = _synth_imagenet_files()
     out = {}
-    # (a) input pipeline alone: fused DCT-scaled decode → uint8 crops
+    # (a) input pipeline alone, PIL vs the fused C++ decode: TFRecords →
+    # scaled JPEG decode → uint8 crops (no device)
     ncpu = os.cpu_count() or 1
-    it = imagenet_iterator(d, 128, "train", device_standardize=True,
-                           num_decode_threads=max(4, ncpu), shuffle_buffer=256)
-    next(it)  # warm the decode pool
-    t0 = time.perf_counter()
-    n_in = 6
-    for _ in range(n_in):
-        next(it)
-    dt = time.perf_counter() - t0
-    out["input_pipeline_images_per_sec"] = round(128 * n_in / dt, 1)
+
+    def pipeline_rate(use_native):
+        it = imagenet_iterator(d, 128, "train", device_standardize=True,
+                               num_decode_threads=max(4, ncpu),
+                               shuffle_buffer=256, use_native=use_native)
+        next(it)  # warm the decode pool
+        t0 = time.perf_counter()
+        n_in = 6
+        for _ in range(n_in):
+            next(it)
+        return round(128 * n_in / (time.perf_counter() - t0), 1)
+
+    out["input_pipeline_images_per_sec"] = pipeline_rate(False)
+    try:
+        from distributed_resnet_tensorflow_tpu.data.native_loader import (
+            native_jpeg_available)
+        if native_jpeg_available():
+            out["input_pipeline_native_images_per_sec"] = pipeline_rate(True)
+    except Exception:
+        pass
     out["host_cores"] = ncpu
 
     if budget_left() < 60:

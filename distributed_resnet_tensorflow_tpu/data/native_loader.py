@@ -69,6 +69,22 @@ def load_library(auto_build: bool = True) -> ctypes.CDLL:
     lib.drt_prefetch_crc_errors.argtypes = [ctypes.c_void_p]
     lib.drt_prefetch_destroy.restype = None
     lib.drt_prefetch_destroy.argtypes = [ctypes.c_void_p]
+    if not hasattr(lib, "drt_has_jpeg") and auto_build:
+        # stale .so from before the JPEG tier: rebuild once
+        del lib
+        if _build():
+            lib = ctypes.CDLL(_SO_PATH)
+        else:
+            lib = ctypes.CDLL(_SO_PATH)  # keep the old tier working
+    if hasattr(lib, "drt_has_jpeg"):
+        lib.drt_has_jpeg.restype = ctypes.c_int
+        lib.drt_has_jpeg.argtypes = []
+    if hasattr(lib, "drt_has_jpeg") and lib.drt_has_jpeg():
+        lib.drt_decode_resize_crop.restype = ctypes.c_int
+        lib.drt_decode_resize_crop.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8)]
     _lib = lib
     return lib
 
@@ -156,3 +172,34 @@ class NativePrefetcher:
             self.close()
         except Exception:
             pass
+
+
+def native_jpeg_available() -> bool:
+    """True iff the .so was built against libjpeg (drt_has_jpeg)."""
+    try:
+        lib = load_library()
+        return bool(getattr(lib, "drt_has_jpeg", lambda: 0)())
+    except NativeUnavailable:
+        return False
+
+
+def decode_resize_crop_native(data: bytes, resize_side: int, top: int,
+                              left: int, out_size: int, flip: bool
+                              ) -> Optional[np.ndarray]:
+    """Fused C++ ImageNet transform: DCT-scaled JPEG decode + bilinear
+    sample of exactly the (out_size², 3) crop window at (top, left) of the
+    conceptual resized image, flipped when asked. The ctypes call releases
+    the GIL, so a Python thread pool around this decodes in true parallel.
+    Returns None when the content needs the PIL fallback (non-JPEG, CMYK,
+    corrupt) or the library lacks libjpeg."""
+    try:
+        lib = load_library()
+    except NativeUnavailable:
+        return None
+    if not getattr(lib, "drt_has_jpeg", lambda: 0)():
+        return None
+    out = np.empty((out_size, out_size, 3), np.uint8)
+    rc = lib.drt_decode_resize_crop(
+        data, len(data), resize_side, top, left, out_size, int(flip),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out if rc == 0 else None

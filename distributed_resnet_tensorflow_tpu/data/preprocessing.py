@@ -85,23 +85,78 @@ def _center_crop(image: np.ndarray, output_size: int) -> np.ndarray:
     return image[top:top + output_size, left:left + output_size]
 
 
+def _header_dims(data: bytes):
+    """(w, h) from the image header only — no pixel decode."""
+    from PIL import Image
+    return Image.open(io.BytesIO(data)).size
+
+
+def _resized_dims(w0: int, h0: int, side: int):
+    scale = side / min(w0, h0)
+    return max(1, round(w0 * scale)), max(1, round(h0 * scale))
+
+
 def train_crop_from_bytes(data: bytes, rng: np.random.RandomState,
                           output_size: int = DEFAULT_IMAGE_SIZE,
                           resize_side_min: int = RESIZE_SIDE_MIN,
-                          resize_side_max: int = RESIZE_SIDE_MAX) -> np.ndarray:
+                          resize_side_max: int = RESIZE_SIDE_MAX,
+                          use_native: bool = False) -> np.ndarray:
     """VGG train preprocessing, uint8 end-to-end (standardization is the
-    device's job — ops/augment.vgg_standardize): random resize side via the
-    fused scaled decode, random crop, random flip."""
+    device's job — ops/augment.vgg_standardize): random resize side via a
+    fused scaled decode, random crop, random flip.
+
+    ``use_native`` routes the decode+resize+crop+flip through ONE C++ call
+    (native_loader.decode_resize_crop_native — DCT-scaled libjpeg decode
+    sampling only the crop window; the ctypes call releases the GIL). The
+    RNG draw order (side, top, left, flip) and the resized-dims arithmetic
+    are identical on both paths, so a fixed seed selects the same crop
+    geometry either way; pixels differ only by the interpolation path."""
     side = rng.randint(resize_side_min, resize_side_max + 1)
+    if use_native:
+        try:
+            w0, h0 = _header_dims(data)
+        except Exception:
+            w0 = None
+        if w0:
+            rw, rh = _resized_dims(w0, h0, side)
+            top = rng.randint(0, max(1, rh - output_size + 1))
+            left = rng.randint(0, max(1, rw - output_size + 1))
+            flip = bool(rng.rand() < 0.5)
+            from .native_loader import decode_resize_crop_native
+            out = decode_resize_crop_native(data, side, top, left,
+                                            output_size, flip)
+            if out is not None:
+                return out
+            # non-JPEG/CMYK/corrupt: PIL path reusing the SAME draws
+            image = decode_and_resize(data, side)
+            crop = image[top:top + output_size, left:left + output_size]
+            if flip:
+                crop = crop[:, ::-1]
+            return np.ascontiguousarray(crop)
     image = decode_and_resize(data, side)
     return np.ascontiguousarray(_random_crop_flip(image, rng, output_size))
 
 
 def eval_crop_from_bytes(data: bytes,
                          output_size: int = DEFAULT_IMAGE_SIZE,
-                         resize_side: int = RESIZE_SIDE_MIN) -> np.ndarray:
+                         resize_side: int = RESIZE_SIDE_MIN,
+                         use_native: bool = False) -> np.ndarray:
     """VGG eval preprocessing, uint8: resize-256 (fused scaled decode) then
-    central crop."""
+    central crop; ``use_native`` as in train_crop_from_bytes."""
+    if use_native:
+        try:
+            w0, h0 = _header_dims(data)
+        except Exception:
+            w0 = None
+        if w0:
+            rw, rh = _resized_dims(w0, h0, resize_side)
+            top = (rh - output_size) // 2
+            left = (rw - output_size) // 2
+            from .native_loader import decode_resize_crop_native
+            out = decode_resize_crop_native(data, resize_side, top, left,
+                                            output_size, False)
+            if out is not None:
+                return out
     return np.ascontiguousarray(
         _center_crop(decode_and_resize(data, resize_side), output_size))
 

@@ -9,8 +9,14 @@
 //
 // Build: make -C distributed_resnet_tensorflow_tpu/native
 //
-// JPEG decode intentionally stays on the Python side (PIL bundles libjpeg
-// and releases the GIL); this layer feeds it raw records at disk speed.
+// JPEG: when jpeglib.h is present at build time (-DDRT_WITH_JPEG, see the
+// Makefile), drt_decode_resize_crop provides the hot ImageNet transform as
+// ONE native pass — DCT-scaled decode (libjpeg scale_num/8, decoding a
+// fraction of the blocks) fused with a bilinear sample of exactly the crop
+// window (+flip) — no full-size pixels, no intermediate resized image.
+// ctypes releases the GIL for the call, so the Python decode thread pool
+// gets true parallelism. Without libjpeg the symbol reports unavailable
+// and the Python PIL path (also scaled: PIL draft) serves instead.
 
 #include <atomic>
 #include <condition_variable>
@@ -234,5 +240,141 @@ void drt_prefetch_destroy(void* handle) {
   for (auto& t : p->threads) t.join();
   delete p;
 }
+
+// ---------------------------------------------------------------------------
+// JPEG scaled decode + fused resize/crop/flip (ImageNet train/eval transform)
+// ---------------------------------------------------------------------------
+
+int drt_has_jpeg() {
+#ifdef DRT_WITH_JPEG
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+#ifdef DRT_WITH_JPEG
+}  // extern "C" (jpeglib.h must not be wrapped)
+#include <jpeglib.h>
+#include <csetjmp>
+extern "C" {
+
+namespace {
+struct DrtJpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+void drt_jpeg_error_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<DrtJpegErr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+}  // namespace
+
+// Decoded-at-scale pixels of `data`, bilinear-sampled directly into the
+// (out_size, out_size, 3) crop at offset (top, left) of the CONCEPTUAL
+// resized image (shorter side == resize_side, aspect preserved, dims
+// rounded like the Python path), horizontally flipped when flip != 0.
+// Returns 0 ok; 1 unsupported content (caller falls back); 2 corrupt.
+int drt_decode_resize_crop(const uint8_t* data, uint64_t len,
+                           int resize_side, int top, int left,
+                           int out_size, int flip, uint8_t* out) {
+  jpeg_decompress_struct cinfo;
+  DrtJpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = drt_jpeg_error_exit;
+  // volatile: assigned between setjmp and a potential longjmp — without it
+  // the error path would free an indeterminate (register-cached) pointer
+  uint8_t* volatile decoded = nullptr;
+  if (setjmp(jerr.jump)) {
+    free(decoded);
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  const int w0 = (int)cinfo.image_width, h0 = (int)cinfo.image_height;
+  if (w0 <= 0 || h0 <= 0) { jpeg_destroy_decompress(&cinfo); return 2; }
+  if (cinfo.jpeg_color_space == JCS_CMYK ||
+      cinfo.jpeg_color_space == JCS_YCCK) {
+    jpeg_destroy_decompress(&cinfo);  // rare; PIL handles these
+    return 1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  // smallest scale_num/8 whose decoded shorter side still covers the
+  // resize target (DCT-domain downscale: fewer blocks decoded)
+  const int min0 = w0 < h0 ? w0 : h0;
+  int num = 8;
+  for (int s = 1; s <= 8; s++) {
+    if ((long)min0 * s >= (long)resize_side * 8) { num = s; break; }
+  }
+  cinfo.scale_num = num;
+  cinfo.scale_denom = 8;
+  jpeg_calc_output_dimensions(&cinfo);
+  const int dw = (int)cinfo.output_width, dh = (int)cinfo.output_height;
+  decoded = (uint8_t*)malloc((size_t)dw * dh * 3);
+  if (!decoded) { jpeg_destroy_decompress(&cinfo); return 2; }
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_components != 3) {  // grayscale converts to RGB above;
+    jpeg_abort_decompress(&cinfo);     // anything else: fall back
+    jpeg_destroy_decompress(&cinfo);
+    free(decoded);
+    return 1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = decoded + (size_t)cinfo.output_scanline * dw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  // conceptual resized dims — EXACTLY the Python formula
+  // (preprocessing.decode_and_resize: round(dim * resize_side / min0))
+  const double scale = (double)resize_side / (double)min0;
+  int rw = (int)(w0 * scale + 0.5), rh = (int)(h0 * scale + 0.5);
+  if (rw < 1) rw = 1;
+  if (rh < 1) rh = 1;
+  // bilinear-sample only the crop window
+  uint8_t* const dec = decoded;  // non-volatile alias for the hot loop
+  for (int r = 0; r < out_size; r++) {
+    const int rr = top + r;
+    const double sy = ((double)rr + 0.5) * dh / rh - 0.5;
+    int y0 = (int)sy;
+    if (sy < 0) y0 = 0;
+    if (y0 > dh - 1) y0 = dh - 1;  // crop windows beyond the resized image
+    int y1 = y0 + 1 < dh ? y0 + 1 : dh - 1;  // clamp-replicate edges
+    double fy = sy - y0;
+    if (fy < 0) fy = 0;
+    if (fy > 1) fy = 1;
+    uint8_t* orow = out + (size_t)r * out_size * 3;
+    for (int c = 0; c < out_size; c++) {
+      const int cc = left + (flip ? (out_size - 1 - c) : c);
+      const double sx = ((double)cc + 0.5) * dw / rw - 0.5;
+      int x0 = (int)sx;
+      if (sx < 0) x0 = 0;
+      if (x0 > dw - 1) x0 = dw - 1;
+      int x1 = x0 + 1 < dw ? x0 + 1 : dw - 1;
+      double fx = sx - x0;
+      if (fx < 0) fx = 0;
+      if (fx > 1) fx = 1;
+      const uint8_t* p00 = dec + ((size_t)y0 * dw + x0) * 3;
+      const uint8_t* p01 = dec + ((size_t)y0 * dw + x1) * 3;
+      const uint8_t* p10 = dec + ((size_t)y1 * dw + x0) * 3;
+      const uint8_t* p11 = dec + ((size_t)y1 * dw + x1) * 3;
+      for (int ch = 0; ch < 3; ch++) {
+        const double v =
+            (1 - fy) * ((1 - fx) * p00[ch] + fx * p01[ch]) +
+            fy * ((1 - fx) * p10[ch] + fx * p11[ch]);
+        orow[c * 3 + ch] = (uint8_t)(v + 0.5);
+      }
+    }
+  }
+  free(dec);
+  return 0;
+}
+#endif  // DRT_WITH_JPEG
 
 }  // extern "C"

@@ -40,27 +40,29 @@ _NEG_INF = -1e30
 BLOCK_Q = 256
 BLOCK_K = 256
 
-# block table from tools/tune_flash_attention.py on TPU v5e (bf16, causal,
-# fwd+bwd grad time over the full {128,256,512}² grid at T ∈ 1k..8k, d=64 —
-# docs/flash_tune_r3.json): each bucket carries its measured winner (e.g.
-# T=4096: 512×512 at 11.9 ms vs 14.9 for the old 256×256 guess; T=8192:
-# 12.5 ms vs dense 126.7 → 10.1×). d=128 is unmeasured and inherits these
-# tiles (VMEM still fits comfortably). Entries must come from the tuner,
-# never intuition — an early guessed 256×512 row measured 1.8× slower than
-# what it replaced.
-_BLOCK_TABLE = (
-    (1024, (512, 512)),
-    (2048, (128, 512)),
-    (4096, (512, 512)),
-    (8192, (512, 512)),
-)
+# block tables from tools/tune_flash_attention.py on TPU v5e (bf16, causal,
+# fwd+bwd grad time over the full {128,256,512}² grid at T ∈ 1k..8k for
+# head dims 64 AND 128 — docs/flash_tune_r3.json): each bucket carries its
+# measured winner (e.g. T=4096 d=64: 512×512 at 11.9 ms vs 14.9 for the
+# old 256×256 guess; T=8192: 12.5 ms vs dense 126.7 → 10.1×). The winners
+# shift with head dim (wider heads → smaller tiles; the VMEM working set
+# per tile scales with d). Entries must come from the tuner, never
+# intuition — an early guessed 256×512 row measured 1.8× slower than what
+# it replaced.
+_BLOCK_TABLES = {
+    64: ((1024, (512, 512)), (2048, (128, 512)),
+         (4096, (512, 512)), (8192, (512, 512))),
+    128: ((1024, (128, 128)), (2048, (256, 256)),
+          (4096, (256, 256)), (8192, (256, 512))),
+}
 
 
 def _pick_blocks(t: int, d: int) -> tuple:
-    for upper, blocks in _BLOCK_TABLE:
+    table = _BLOCK_TABLES[64 if d <= 96 else 128]
+    for upper, blocks in table:
         if t <= upper:
             return blocks
-    return _BLOCK_TABLE[-1][1]
+    return table[-1][1]
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,

@@ -5,6 +5,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_resnet_tensorflow_tpu.data import cifar_iterator, standardize
 from distributed_resnet_tensorflow_tpu.ops import augment
@@ -108,6 +109,7 @@ def test_raw_iterator_and_device_augment_train_step(tmp_path):
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.heavy
 def test_device_dataset_matches_streamed_path(tmp_path):
     """HBM-resident dataset + index batches == streamed raw-uint8 batches:
     same permutation (same seed), same device augmentation (rng is

@@ -31,6 +31,7 @@ def _tiny_cfg(tmp_path, **kw):
     return cfg
 
 
+@pytest.mark.heavy
 def test_save_restore_roundtrip(tmp_path):
     cfg = _tiny_cfg(tmp_path)
     tr = Trainer(cfg)
@@ -54,6 +55,7 @@ def test_save_restore_roundtrip(tmp_path):
     mngr.close()
 
 
+@pytest.mark.heavy
 def test_cross_topology_restore(tmp_path):
     """Elastic resume: a checkpoint written under one mesh (fsdp=2) restores
     into trainers on DIFFERENT topologies (pure dp, and fsdp=4) bit-exactly,
@@ -122,6 +124,7 @@ def test_step_and_time_cadence(tmp_path):
     mngr.close(); mngr2.close()
 
 
+@pytest.mark.heavy
 def test_auto_resume_continues_training(tmp_path):
     """run_train resumes from latest checkpoint — MonitoredTrainingSession
     auto-resume parity (SURVEY.md §2.14)."""

@@ -55,6 +55,7 @@ def test_param_sharding_rule(mesh_dp_fsdp):
     assert param_sharding_rule("odd", (513, 1023), mesh_dp_fsdp) == P()
 
 
+@pytest.mark.heavy
 def test_sharded_step_matches_single_device(mesh8):
     """The crux: dp-sharded training step == serial step (sync DP exactness).
     The reference could only approximate this promise through

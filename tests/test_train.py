@@ -96,6 +96,7 @@ def test_loss_weight_decay_hand_computed():
     assert loss_weight_decay(params, 0.0) == 0.0
 
 
+@pytest.mark.heavy
 def test_decay_all_params_config_increases_loss():
     """optimizer.decay_all_params=True adds BN/bias L2 on top of kernels."""
     def run(decay_all):
@@ -115,6 +116,7 @@ def test_decay_all_params_config_increases_loss():
     assert loss_a > loss_k
 
 
+@pytest.mark.heavy
 def test_grad_accum_matches_big_batch():
     """2 microbatches of 8 == one batch of 16 (grads averaged). Uses the
     BN-free logistic model where the equivalence is exact; with BN the
@@ -144,6 +146,7 @@ def test_grad_accum_matches_big_batch():
                       rtol=1e-5)
 
 
+@pytest.mark.heavy
 def test_fused_xent_train_step_matches_optax():
     """train.fused_xent=interpret (Pallas kernel, CPU interpreter) produces
     the same step as the optax path — including gradients, via the custom

@@ -56,12 +56,14 @@ def main():
         with open(args.out) as f:
             prev = json.load(f)
         done = {(r["t"], r["d"]): r for r in prev.get("results", [])}
+        # carry EVERY previously-measured point — a --dims/--seqs subset run
+        # must extend the evidence file, not clobber it
+        results.extend(prev.get("results", []))
     else:
         done = {}
     for t in map(int, args.seqs.split(",")):
         for d in map(int, args.dims.split(",")):
             if (t, d) in done:
-                results.append(done[(t, d)])
                 continue
             h = max(1, args.heads_budget // (t * d))
             rng = np.random.RandomState(0)
