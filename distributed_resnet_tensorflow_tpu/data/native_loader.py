@@ -48,6 +48,12 @@ def load_library(auto_build: bool = True) -> ctypes.CDLL:
             raise NativeUnavailable(
                 f"{_SO_PATH} not built (run `make -C {_NATIVE_DIR}`)")
     lib = ctypes.CDLL(_SO_PATH)
+    if not hasattr(lib, "drt_has_jpeg") and auto_build:
+        # stale .so from before the JPEG tier: rebuild BEFORE any bindings
+        # are configured (a re-created CDLL would reset restype/argtypes)
+        del lib
+        _build()
+        lib = ctypes.CDLL(_SO_PATH)
     lib.drt_crc32c.restype = ctypes.c_uint32
     lib.drt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
     lib.drt_masked_crc32c.restype = ctypes.c_uint32
@@ -69,13 +75,6 @@ def load_library(auto_build: bool = True) -> ctypes.CDLL:
     lib.drt_prefetch_crc_errors.argtypes = [ctypes.c_void_p]
     lib.drt_prefetch_destroy.restype = None
     lib.drt_prefetch_destroy.argtypes = [ctypes.c_void_p]
-    if not hasattr(lib, "drt_has_jpeg") and auto_build:
-        # stale .so from before the JPEG tier: rebuild once
-        del lib
-        if _build():
-            lib = ctypes.CDLL(_SO_PATH)
-        else:
-            lib = ctypes.CDLL(_SO_PATH)  # keep the old tier working
     if hasattr(lib, "drt_has_jpeg"):
         lib.drt_has_jpeg.restype = ctypes.c_int
         lib.drt_has_jpeg.argtypes = []

@@ -256,6 +256,7 @@ int drt_has_jpeg() {
 #ifdef DRT_WITH_JPEG
 }  // extern "C" (jpeglib.h must not be wrapped)
 #include <jpeglib.h>
+#include <cmath>
 #include <csetjmp>
 extern "C" {
 
@@ -332,9 +333,12 @@ int drt_decode_resize_crop(const uint8_t* data, uint64_t len,
   jpeg_destroy_decompress(&cinfo);
 
   // conceptual resized dims — EXACTLY the Python formula
-  // (preprocessing.decode_and_resize: round(dim * resize_side / min0))
+  // (preprocessing._resized_dims: round(dim * resize_side / min0)).
+  // lrint under the default FE_TONEAREST mode is round-half-EVEN, matching
+  // Python round(); (int)(v + 0.5) would be half-up and drift by one row
+  // on exact-.5 products, shifting the crop against the drawn offsets
   const double scale = (double)resize_side / (double)min0;
-  int rw = (int)(w0 * scale + 0.5), rh = (int)(h0 * scale + 0.5);
+  int rw = (int)lrint(w0 * scale), rh = (int)lrint(h0 * scale);
   if (rw < 1) rw = 1;
   if (rh < 1) rh = 1;
   // bilinear-sample only the crop window

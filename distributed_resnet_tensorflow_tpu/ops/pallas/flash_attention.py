@@ -380,8 +380,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Pallas flash attention, (B, T, H, D). Differentiable with a FUSED
     Pallas backward (dq + dk/dv kernels recomputing P from the lse
     residual — O(T) memory, no extra full forward). ``block_q``/``block_k``
-    of 0 pick the measured-optimal tile for the sequence length
-    (_BLOCK_TABLE; tools/tune_flash_attention.py re-derives it)."""
+    of 0 pick the measured-optimal tile for the sequence length and head
+    dim (_BLOCK_TABLES; tools/tune_flash_attention.py re-derives them)."""
     bq, bk = _resolve_blocks(q, block_q, block_k)
     return _flash_forward(q, k, v, causal, interpret,
                           block_q=bq, block_k=bk)

@@ -15,6 +15,10 @@ pattern, and per-image standardization removes only mean/scale.
 Swap in the real CIFAR-10 binaries and every command runs unchanged.
 
 Usage: python tools/make_synth_cifar.py [out_dir] [--train N] [--test N]
+       python tools/make_synth_cifar.py out_dir --format cifar100  # train.bin/
+           test.bin with [coarse, fine] label bytes, 100 learnable fine
+           classes coded by (radial frequency × angular harmonic × channel
+           mix) — all survive per-image standardization and ±4-crop/flip
 """
 from __future__ import annotations
 
@@ -26,28 +30,54 @@ import numpy as np
 NUM_CLASSES = 10
 
 
-def class_images(cls: int, n: int, rng: np.random.RandomState) -> np.ndarray:
-    """(n, 32, 32, 3) uint8 images for one class."""
+def class_images(cls: int, n: int, rng: np.random.RandomState,
+                 num_classes: int = NUM_CLASSES) -> np.ndarray:
+    """(n, 32, 32, 3) uint8 images for one class.
+
+    10-class coding: 5 radial frequencies × 2 channel mixes. 100-class
+    coding adds a 5-level angular harmonic (cos kθ, scale-invariant and
+    |·|-preserved under flips): (cls%10) frequencies × ((cls//10)%5)
+    harmonics × (cls//50) mixes."""
     yy, xx = np.mgrid[0:32, 0:32]
     r = np.sqrt((yy - 15.5) ** 2 + (xx - 15.5) ** 2)          # (32, 32)
-    freq = 0.10 + 0.018 * (cls % 5)                            # 5 frequencies
-    # channel mixes: two mildly-separated triplets select the other factor
-    w = np.array([[1.0, 0.5, -0.2], [0.5, 1.0, 0.2]][cls // 5])
+    theta = np.arctan2(yy - 15.5, xx - 15.5)
+    if num_classes <= 10:
+        freq = 0.10 + 0.018 * (cls % 5)
+        harmonic = 1.0
+        w = np.array([[1.0, 0.5, -0.2], [0.5, 1.0, 0.2]][cls // 5])
+    else:
+        freq = 0.08 + 0.016 * (cls % 10)                       # 10 frequencies
+        k = (cls // 10) % 5                                    # 5 harmonics
+        harmonic = 1.0 + 0.6 * np.cos(k * theta)
+        w = np.array([[1.0, 0.5, -0.2], [0.5, 1.0, 0.2]][cls // 50])
     phase = rng.uniform(0, 2 * np.pi, size=(n, 1, 1))
-    base = np.cos(2 * np.pi * freq * r[None] + phase)          # (n, 32, 32)
+    base = np.cos(2 * np.pi * freq * r[None] + phase) * harmonic[None]
     img = (128.0 + 18.0 * base[..., None] * w[None, None, None, :]
-           + rng.normal(0, 48.0, (n, 32, 32, 3)))
+           + rng.normal(0, 40.0, (n, 32, 32, 3)))
     return np.clip(img, 0, 255).astype(np.uint8)
 
 
-def make_split(n: int, seed: int) -> tuple[np.ndarray, np.ndarray]:
+def make_split(n: int, seed: int,
+               num_classes: int = NUM_CLASSES) -> tuple[np.ndarray, np.ndarray]:
     rng = np.random.RandomState(seed)
-    per = n // NUM_CLASSES
+    per = n // num_classes
     images = np.concatenate(
-        [class_images(c, per, rng) for c in range(NUM_CLASSES)])
-    labels = np.repeat(np.arange(NUM_CLASSES), per).astype(np.uint8)
+        [class_images(c, per, rng, num_classes) for c in range(num_classes)])
+    labels = np.repeat(np.arange(num_classes), per).astype(np.uint8)
     order = rng.permutation(len(labels))
     return images[order], labels[order]
+
+
+def write_cifar100_files(out_dir: str, images: np.ndarray,
+                         labels: np.ndarray, name: str) -> None:
+    """cifar100 binary layout: [coarse byte][fine byte][3072 CHW bytes]
+    (data/cifar.py reads the fine byte at offset 1)."""
+    os.makedirs(out_dir, exist_ok=True)
+    recs = np.empty((len(labels), 2 + 3072), np.uint8)
+    recs[:, 0] = labels // 5   # a consistent 20-group coarse labeling
+    recs[:, 1] = labels
+    recs[:, 2:] = images.transpose(0, 3, 1, 2).reshape(len(labels), -1)
+    recs.tofile(os.path.join(out_dir, name))
 
 
 def write_cifar_files(out_dir: str, images: np.ndarray, labels: np.ndarray,
@@ -68,14 +98,21 @@ def main() -> None:
     ap.add_argument("--train", type=int, default=50000)
     ap.add_argument("--test", type=int, default=10000)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--format", choices=("cifar10", "cifar100"),
+                    default="cifar10")
     args = ap.parse_args()
-    tr_im, tr_lb = make_split(args.train, args.seed)
-    te_im, te_lb = make_split(args.test, args.seed + 1)
-    write_cifar_files(args.out_dir, tr_im, tr_lb,
-                      [f"data_batch_{i}.bin" for i in range(1, 6)])
-    write_cifar_files(args.out_dir, te_im, te_lb, ["test_batch.bin"])
-    print(f"wrote {args.train} train + {args.test} test records to "
-          f"{args.out_dir}")
+    nc = 100 if args.format == "cifar100" else NUM_CLASSES
+    tr_im, tr_lb = make_split(args.train, args.seed, nc)
+    te_im, te_lb = make_split(args.test, args.seed + 1, nc)
+    if args.format == "cifar100":
+        write_cifar100_files(args.out_dir, tr_im, tr_lb, "train.bin")
+        write_cifar100_files(args.out_dir, te_im, te_lb, "test.bin")
+    else:
+        write_cifar_files(args.out_dir, tr_im, tr_lb,
+                          [f"data_batch_{i}.bin" for i in range(1, 6)])
+        write_cifar_files(args.out_dir, te_im, te_lb, ["test_batch.bin"])
+    print(f"wrote {args.train} train + {args.test} test {args.format} "
+          f"records to {args.out_dir}")
 
 
 if __name__ == "__main__":

@@ -3,7 +3,7 @@
 Measures fwd+bwd (grad) wall time over (block_q, block_k) ∈ {128,256,512}²
 for T ∈ {1024, 2048, 4096, 8192} × head dim ∈ {64, 128} (bf16, causal), plus
 the XLA dense and blockwise baselines at each point — the evidence for
-ops/pallas/flash_attention._BLOCK_TABLE and for the dense→flash ``auto``
+ops/pallas/flash_attention._BLOCK_TABLES and for the dense→flash ``auto``
 crossover in models/transformer.py.
 
     python tools/tune_flash_attention.py [--out docs/flash_tune_r3.json]
