@@ -48,9 +48,16 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 5,
                  save_every_steps: int = 0, save_every_secs: float = 0.0,
-                 async_save: bool = True):
+                 async_save: bool = True,
+                 layout_stamp: Optional[dict] = None):
+        # layout_stamp: declares how depth-stacked params are ORDERED (the
+        # circular pipeline schedule stores stage-major order, a function of
+        # (pstages, interleave) — models/pipeline.py). Saved as a sidecar so
+        # a restore under a different layout fails loudly instead of running
+        # layers in a silently-permuted network order.
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self._layout_stamp = layout_stamp
         self.save_every_steps = save_every_steps
         self.save_every_secs = save_every_secs
         self._last_save_time = time.monotonic()
@@ -60,6 +67,9 @@ class CheckpointManager:
             enable_async_checkpointing=async_save,
         )
         self._mngr = ocp.CheckpointManager(self.directory, options=options)
+        # fail at construction, not at the first save cadence minutes into
+        # training: everything the layout check needs already exists here
+        self._check_layout()
 
     # -- policy ------------------------------------------------------------
     def should_save(self, step: int) -> bool:
@@ -81,9 +91,65 @@ class CheckpointManager:
         return True
 
     # -- mechanics ---------------------------------------------------------
+    @property
+    def _layout_path(self) -> str:
+        return os.path.join(self.directory, "layout.json")
+
+    def saved_layout(self) -> Optional[dict]:
+        import json
+        try:
+            with open(self._layout_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            # unreadable/corrupt sidecar ranks as absent; _check_layout then
+            # assumes the conservative network order, which refuses rather
+            # than silently permutes
+            return None
+
+    def _check_layout(self) -> None:
+        cur = self._layout_stamp
+        if cur is None:
+            return  # caller declared no stacked layout — nothing to enforce
+        if self.latest_step() is None:
+            # no committed checkpoint — an orphaned sidecar (stamp written,
+            # save failed) conflicts with nothing and gets overwritten
+            return
+        # checkpoints that predate layout stamping could only have been
+        # network order
+        saved = self.saved_layout() or {"encoder_order": "network"}
+        circular = "circular" in (saved.get("encoder_order"),
+                                  cur.get("encoder_order"))
+        if circular and saved != cur:
+            raise ValueError(
+                f"checkpoint {self.directory} stores stacked encoder params "
+                f"in layout {saved} but this run uses {cur}; restoring would "
+                "silently permute layer order. Migrate with "
+                "models.pipeline.repack_stacked_params, or match "
+                "mesh.pipeline / model.vit_pipeline_interleave")
+
+    def _write_layout(self) -> None:
+        # chief-only + atomic: every host shares this directory, and
+        # concurrent truncating writes could leave unparseable JSON
+        if jax.process_index() != 0:
+            return
+        import json
+        import tempfile
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".layout")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._layout_stamp, f)
+            os.replace(tmp, self._layout_path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
     def save(self, step: int, state, force: bool = False) -> None:
         if step in self._mngr.all_steps():
             return  # idempotent: step already checkpointed
+        self._check_layout()
+        if self._layout_stamp is not None and (
+                self.saved_layout() != self._layout_stamp):
+            self._write_layout()
         self._mngr.save(step, args=ocp.args.StandardSave(_saveable(state)),
                         force=force)
         self._last_save_time = time.monotonic()
@@ -101,6 +167,7 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             return state, None
+        self._check_layout()
         abstract = jax.tree_util.tree_map(
             ocp.utils.to_shape_dtype_struct, _saveable(state))
         restored = self._mngr.restore(

@@ -39,21 +39,37 @@ def _build() -> bool:
         return False
 
 
+def _so_exports(symbol: bytes) -> bool:
+    """Probe the on-disk .so for an exported symbol WITHOUT dlopen-ing it.
+
+    Staleness must be decided before the first ``ctypes.CDLL``: glibc caches
+    dlopen handles by device/inode and ``make`` relinks in place, so once the
+    old mapping exists a rebuild+re-CDLL hands back the stale symbol table.
+    Exported names live verbatim in .dynstr, so a raw substring scan is a
+    sufficient probe."""
+    try:
+        with open(_SO_PATH, "rb") as f:
+            return symbol in f.read()
+    except OSError:
+        return False
+
+
 def load_library(auto_build: bool = True) -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO_PATH):
-        if not (auto_build and _build()):
+    stale = os.path.exists(_SO_PATH) and not _so_exports(b"drt_has_jpeg")
+    if not os.path.exists(_SO_PATH) or stale:
+        if not (auto_build and _build()) and not os.path.exists(_SO_PATH):
             raise NativeUnavailable(
                 f"{_SO_PATH} not built (run `make -C {_NATIVE_DIR}`)")
     lib = ctypes.CDLL(_SO_PATH)
-    if not hasattr(lib, "drt_has_jpeg") and auto_build:
-        # stale .so from before the JPEG tier: rebuild BEFORE any bindings
-        # are configured (a re-created CDLL would reset restype/argtypes)
-        del lib
-        _build()
-        lib = ctypes.CDLL(_SO_PATH)
+    if not hasattr(lib, "drt_has_jpeg"):
+        # pre-JPEG-tier build still mapped (rebuild failed, or another
+        # component dlopened the stale file first) — the JPEG fast path is
+        # unavailable for this process; core bindings below still work
+        log.warning("libdrtdata.so predates the JPEG tier and cannot be "
+                    "reloaded in-process; JPEG decode falls back to python")
     lib.drt_crc32c.restype = ctypes.c_uint32
     lib.drt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
     lib.drt_masked_crc32c.restype = ctypes.c_uint32

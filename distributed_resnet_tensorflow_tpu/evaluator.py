@@ -41,9 +41,10 @@ class Evaluator:
         self.cfg = cfg
         self.trainer = Trainer(cfg)
         self.trainer.init_state()
-        from .utils.config import resolve_checkpoint_dir
+        from .utils.config import resolve_checkpoint_dir, stacked_layout_stamp
         self.manager = CheckpointManager(
-            resolve_checkpoint_dir(cfg), max_to_keep=1_000_000)
+            resolve_checkpoint_dir(cfg), max_to_keep=1_000_000,
+            layout_stamp=stacked_layout_stamp(cfg))
         self.writer = writer
         self.best_precision = 0.0   # reference best_precision tracking
         self.last_step: Optional[int] = None

@@ -30,7 +30,8 @@ from .evaluator import Evaluator, make_eval_iterator
 from .parallel import initialize_from_config, is_chief
 from .train.hooks import CheckpointHook, LoggingHook, NanGuardHook, SummaryHook
 from .train.loop import Trainer
-from .utils.config import ExperimentConfig, parse_args, resolve_checkpoint_dir
+from .utils.config import (ExperimentConfig, parse_args,
+                           resolve_checkpoint_dir, stacked_layout_stamp)
 from .utils.metrics import MetricsWriter
 
 log = logging.getLogger(__name__)
@@ -104,7 +105,8 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         resolve_checkpoint_dir(cfg), max_to_keep=cfg.checkpoint.max_to_keep,
         save_every_steps=cfg.checkpoint.save_every_steps,
         save_every_secs=cfg.checkpoint.save_every_secs,
-        async_save=cfg.checkpoint.async_save)
+        async_save=cfg.checkpoint.async_save,
+        layout_stamp=stacked_layout_stamp(cfg))
 
     start_step = 0
     if cfg.checkpoint.resume:
@@ -167,7 +169,8 @@ def run_train_and_eval(cfg: ExperimentConfig):
         resolve_checkpoint_dir(cfg), max_to_keep=cfg.checkpoint.max_to_keep,
         save_every_steps=cfg.checkpoint.save_every_steps,
         save_every_secs=cfg.checkpoint.save_every_secs,
-        async_save=cfg.checkpoint.async_save)
+        async_save=cfg.checkpoint.async_save,
+        layout_stamp=stacked_layout_stamp(cfg))
     if cfg.checkpoint.resume:
         trainer.state, _ = manager.restore(trainer.state)
 

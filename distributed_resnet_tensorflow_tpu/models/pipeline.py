@@ -17,6 +17,24 @@ parallel); this module completes the mesh: every axis of
     reversed, ppermute inverted) — the backward pipeline for free.
   * Bubble ticks compute on zero-activations and are masked out of the
     result; the bubble fraction is (P-1)/(M+P-1), so M defaults to 2P.
+  * ``interleave`` (v) > 1 runs the CIRCULAR schedule (Megatron interleaved /
+    praxis circular): each stage holds v non-adjacent chunks of depth/(P*v)
+    layers and every microbatch rides the ring v times, shrinking the bubble
+    to (P-1)/(v*M+P-1) at the cost of v× more ICI hops per microbatch. At
+    tick t, stage s works on u = t - s decomposed as (chunk, microbatch) =
+    (u // M, u mod M); wrapped activations re-enter stage 0 through a
+    per-microbatch queue because the wrap takes M-P+1 ticks (requires
+    M >= P). v=1 reduces to plain GPipe.
+
+On 1F1B: the schedule that cuts *activation memory* (not the bubble) to
+O(P) microbatches per stage requires launching each microbatch's backward
+eagerly, interleaved with later forwards — a per-microbatch autograd runtime,
+which fights XLA's whole-program compilation model. The TPU-native
+equivalents are (a) this circular schedule, which attacks the bubble
+directly, and (b) ``remat=True``, which bounds the per-tick residual to the
+stage inputs that reverse-mode scan transposition must keep — the same
+stage-boundary stash 1F1B keeps, held for the whole step rather than P
+ticks. Both compose.
 
 The block math mirrors ``transformer.EncoderBlock`` op-for-op (pre-LN MHA +
 pre-LN MLP with residuals) but is written against explicit stacked params so
@@ -90,6 +108,7 @@ class PipelinedEncoder(nn.Module):
     mesh: Any = None
     microbatches: int = 0  # 0 → 2 × pipeline stages
     remat: bool = False    # jax.checkpoint each block (GPipe's usual pairing)
+    interleave: int = 1    # v>1 → circular schedule, v chunks per stage
 
     def _params(self, d):
         hd = d // self.num_heads
@@ -146,9 +165,11 @@ class PipelinedEncoder(nn.Module):
                                          self.dtype, tp_ax), None),
                 h, p)[0]
 
-        if pstages > 1 and nblocks % pstages:
+        v = max(1, self.interleave)
+        if pstages > 1 and nblocks % (pstages * v):
             raise ValueError(
-                f"depth {nblocks} not divisible by pipeline stages {pstages}")
+                f"depth {nblocks} not divisible by pipeline stages "
+                f"{pstages} x interleave {v}")
         if tp_axis is not None:
             if self.num_heads % tp:
                 raise ValueError(
@@ -158,6 +179,12 @@ class PipelinedEncoder(nn.Module):
                     f"mlp hidden {self.mlp_ratio * d} not divisible by "
                     f"tensor axis {tp}")
         m = self.microbatches or 2 * pstages
+        if v > 1 and pstages > 1 and m < pstages:
+            # the circular wrap takes M-P+1 ticks; M >= P keeps the stage-0
+            # re-injection queue causally ahead of its consumption
+            raise ValueError(
+                f"interleave {v} requires microbatches ({m}) >= pipeline "
+                f"stages ({pstages})")
         # microbatching applies to the LOCAL batch: each data-parallel shard
         # runs its own pipeline over its slice of the batch
         if self.mesh is not None:
@@ -221,21 +248,117 @@ class PipelinedEncoder(nn.Module):
                 "pipeline")
             return out.reshape(xg.shape)
 
+        def pipelined_circular(p_local, xg):
+            """Circular schedule: v chunks of k layers per stage, vM+P-1
+            ticks; stage s at tick t works item u = t - s as
+            (chunk, microbatch) = (u // M, u mod M). Stage P-1's output for
+            chunk c < v-1 rides the same ppermute ring back to stage 0,
+            which parks it in a per-microbatch queue until its chunk-(c+1)
+            slot comes up M-P+1 ticks later."""
+            k = nblocks // (pstages * v)
+            stage = lax.axis_index("pipeline")
+            mb = xg.shape[0] // m
+            xs = xg.reshape((m, mb) + xg.shape[1:])
+
+            def chunk_params(p, c):
+                return jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_slice_in_dim(a, c * k, k, axis=0),
+                    p)
+
+            def tick(carry, tt):
+                prev, wrapq, out = carry
+                recv = lax.ppermute(prev, "pipeline", perm)
+                u = tt - stage
+                mi = jnp.mod(u, m)
+                ci = jnp.floor_divide(u, m)
+                # stage 0: park the wrapped activation that stage P-1
+                # produced at tick tt-1 (its work item was u' = tt - P)
+                up = tt - pstages
+                store = jnp.logical_and(
+                    stage == 0,
+                    jnp.logical_and(up >= 0,
+                                    jnp.floor_divide(up, m) < v - 1))
+                wrapq = jnp.where(
+                    store,
+                    lax.dynamic_update_index_in_dim(
+                        wrapq, recv.astype(wrapq.dtype), jnp.mod(up, m),
+                        axis=0),
+                    wrapq)
+                mi_c = jnp.clip(mi, 0, m - 1)
+                inject = lax.dynamic_index_in_dim(xs, mi_c, axis=0,
+                                                  keepdims=False)
+                parked = lax.dynamic_index_in_dim(wrapq, mi_c, axis=0,
+                                                  keepdims=False)
+                h = jnp.where(stage == 0,
+                              jnp.where(ci == 0, inject, parked), recv)
+                y = run_layers(chunk_params(p_local, jnp.clip(ci, 0, v - 1)),
+                               h, tp_axis)
+                write = jnp.logical_and(stage == pstages - 1,
+                                        jnp.logical_and(ci == v - 1, u >= 0))
+                upd = lax.dynamic_update_index_in_dim(
+                    out, y.astype(out.dtype), mi_c, axis=0)
+                out = jnp.where(write, upd, out)
+                return (y, wrapq, out), None
+
+            zero = jnp.zeros((mb,) + xg.shape[1:], xg.dtype)
+            (last, _wq, out), _ = lax.scan(
+                tick, (zero, jnp.zeros_like(xs), jnp.zeros_like(xs)),
+                jnp.arange(v * m + pstages - 1))
+            out = lax.psum(
+                jnp.where(stage == pstages - 1, out, jnp.zeros_like(out)),
+                "pipeline")
+            return out.reshape(xg.shape)
+
         from ..parallel.mesh import shard_map_compat
-        fn = shard_map_compat(pipelined, mesh, in_specs=(p_spec, x_spec),
+        body = pipelined if v == 1 else pipelined_circular
+        fn = shard_map_compat(body, mesh, in_specs=(p_spec, x_spec),
                               out_specs=x_spec)
         return fn(params, x)
 
 
-def pack_encoder_params(vit_params: dict, depth: int) -> dict:
+def circular_layer_order(depth: int, pstages: int, interleave: int):
+    """stored-row -> network-layer index map for the stacked layout.
+
+    GPipe (interleave=1) stacks layers in network order; the circular
+    schedule stores stage-major order (stage s's rows are its v chunks
+    back-to-back, keeping the ``pipeline`` sharding of axis 0 contiguous):
+    stored[s*(v*k) + c*k + i] = network[(c*pstages + s)*k + i].
+    """
+    import numpy as np
+    v = max(1, interleave)
+    if depth % (pstages * v):
+        raise ValueError(f"depth {depth} not divisible by {pstages}x{v}")
+    k = depth // (pstages * v)
+    net = np.arange(depth).reshape(v, pstages, k)
+    return net.transpose(1, 0, 2).reshape(depth)
+
+
+def repack_stacked_params(stacked, depth: int, src=(1, 1), dst=(1, 1)):
+    """Re-permute every depth-stacked leaf of an encoder param tree between
+    storage layouts — checkpoint migration when (mesh.pipeline, interleave)
+    changes between save and restore (the checkpoint manager refuses such
+    restores; this is the deliberate-migration path). ``src``/``dst`` are
+    (pstages, interleave) pairs; (P, 1) and (1, v) are both network order."""
+    import numpy as np
+    src_order = circular_layer_order(depth, *src)
+    dst_order = circular_layer_order(depth, *dst)
+    idx = jnp.asarray(np.argsort(src_order)[dst_order])
+    return jax.tree_util.tree_map(lambda a: jnp.take(a, idx, axis=0), stacked)
+
+
+def pack_encoder_params(vit_params: dict, depth: int, pstages: int = 1,
+                        interleave: int = 1) -> dict:
     """Stack a standard per-block ViT param tree (EncoderBlock_i modules)
     into the PipelinedEncoder layout — checkpoint migration between the
-    unpipelined and pipelined parameterizations."""
+    unpipelined and pipelined parameterizations. ``pstages``/``interleave``
+    select the circular stacking order (no-ops at their defaults)."""
+    order = circular_layer_order(depth, max(1, pstages), interleave)
+
     def block(i):
         return vit_params[f"EncoderBlock_{i}"]
 
     def stack(fn):
-        return jnp.stack([jnp.asarray(fn(block(i))) for i in range(depth)])
+        return jnp.stack([jnp.asarray(fn(block(int(i)))) for i in order])
 
     return {
         "ln1_scale": stack(lambda b: b["LayerNorm_0"]["scale"]),

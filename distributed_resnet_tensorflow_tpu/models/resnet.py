@@ -339,6 +339,7 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
             num_heads=model_cfg.vit_heads, dtype=dtype,
             attention_impl=attn, remat=remat, mesh=mesh,
             pipeline_microbatches=model_cfg.vit_pipeline_microbatches,
+            pipeline_interleave=model_cfg.vit_pipeline_interleave,
             num_experts=model_cfg.vit_num_experts,
             expert_capacity_factor=model_cfg.vit_expert_capacity_factor,
             moe_top_k=model_cfg.vit_moe_top_k,

@@ -154,6 +154,7 @@ class VisionTransformer(nn.Module):
     # single-device semantics; arrays may still be batch-sharded by jit.
     mesh: Any = None
     pipeline_microbatches: int = 0  # 0 → 2 × pipeline stages
+    pipeline_interleave: int = 1    # v>1 → circular schedule (v chunks/stage)
     num_experts: int = 0            # >0 → Switch MoE MLPs over `expert`
     expert_capacity_factor: float = 1.25
     moe_top_k: int = 1
@@ -203,6 +204,7 @@ class VisionTransformer(nn.Module):
                                  mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                                  mesh=mesh,
                                  microbatches=self.pipeline_microbatches,
+                                 interleave=self.pipeline_interleave,
                                  remat=self.remat,
                                  name="encoder")(x)
         else:

@@ -51,6 +51,9 @@ class ModelConfig:
     vit_heads: int = 4
     # GPipe microbatches when mesh.pipeline > 1 (0 → 2 × stages)
     vit_pipeline_microbatches: int = 0
+    # >1 → circular (Megatron-interleaved) schedule: v chunks per stage,
+    # bubble (P-1)/(v*M+P-1); requires depth % (P*v) == 0 and M >= P
+    vit_pipeline_interleave: int = 1
     # Switch MoE: >0 replaces the block MLPs with num_experts experts
     # (models/moe.py), shardable over mesh.expert
     vit_num_experts: int = 0
@@ -352,6 +355,23 @@ def resolve_checkpoint_dir(cfg: ExperimentConfig) -> str:
     reference, SURVEY.md §3.3)."""
     import os
     return cfg.checkpoint.directory or os.path.join(cfg.log_root, "ckpt")
+
+
+def stacked_layout_stamp(cfg: ExperimentConfig):
+    """Storage-order declaration for depth-stacked encoder params, recorded
+    next to checkpoints: the circular pipeline schedule
+    (model.vit_pipeline_interleave > 1) stores stage-major layer order, so a
+    restore under a different (mesh.pipeline, interleave) must be refused
+    (models/pipeline.py circular_layer_order / repack_stacked_params).
+    None = no stacked params in this model family."""
+    if cfg.model.name != "vit":
+        return None
+    v = cfg.model.vit_pipeline_interleave
+    p = cfg.mesh.pipeline
+    if v <= 1 or p <= 1:
+        return {"encoder_order": "network"}
+    return {"encoder_order": "circular", "pstages": p, "interleave": v,
+            "depth": cfg.model.vit_depth}
 
 
 def get_preset(name: str) -> ExperimentConfig:

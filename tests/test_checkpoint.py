@@ -184,3 +184,92 @@ def test_evaluator_tracks_best_precision(tmp_path):
     out = ev.run(timeout_secs=0.0)
     assert out == {} or isinstance(out, dict)
     mngr.close()
+
+
+def test_layout_stamp_mismatch_refused(tmp_path):
+    """A checkpoint written with the circular pipeline layout must refuse a
+    restore under a different (pstages, interleave) — the stacked rows would
+    silently run in a permuted network order (models/pipeline.py)."""
+    state = {"x": np.arange(4.0)}
+
+    class S:  # minimal state-like object for _saveable
+        step = 0
+        params = {"w": np.arange(4.0)}
+        batch_stats = {}
+        opt_state = {}
+
+        def replace(self, **kw):
+            return self
+
+    circ = {"encoder_order": "circular", "pstages": 4, "interleave": 2,
+            "depth": 8}
+    m1 = CheckpointManager(os.path.join(str(tmp_path), "c"), async_save=False,
+                           layout_stamp=circ)
+    m1.save(1, S(), force=True)
+    m1.wait_until_finished()
+    assert m1.saved_layout() == circ
+    m1.close()
+
+    # same layout: restore proceeds
+    m_ok = CheckpointManager(os.path.join(str(tmp_path), "c"),
+                             async_save=False, layout_stamp=dict(circ))
+    m_ok.restore(S())
+    m_ok.close()
+
+    # different pipeline split: refused already at construction
+    other = dict(circ, pstages=2)
+    with pytest.raises(ValueError, match="layout|permute"):
+        CheckpointManager(os.path.join(str(tmp_path), "c"),
+                          async_save=False, layout_stamp=other)
+
+    # network-order run against a circular checkpoint: refused too
+    with pytest.raises(ValueError, match="layout|permute"):
+        CheckpointManager(os.path.join(str(tmp_path), "c"),
+                          async_save=False,
+                          layout_stamp={"encoder_order": "network"})
+
+    # an orphaned sidecar (stamp written, no step ever committed) must NOT
+    # poison the directory for a different layout
+    orphan_dir = os.path.join(str(tmp_path), "orphan")
+    os.makedirs(orphan_dir)
+    import json
+    with open(os.path.join(orphan_dir, "layout.json"), "w") as f:
+        json.dump(circ, f)
+    m_orph = CheckpointManager(orphan_dir, async_save=False,
+                               layout_stamp={"encoder_order": "network"})
+    m_orph.save(1, S(), force=True)
+    m_orph.wait_until_finished()
+    assert m_orph.saved_layout() == {"encoder_order": "network"}
+    m_orph.close()
+
+    # a corrupt sidecar next to committed checkpoints refuses loudly for a
+    # circular run (conservative network-order assumption), never permutes
+    with open(os.path.join(str(tmp_path), "c", "layout.json"), "w") as f:
+        f.write("{truncated")
+    with pytest.raises(ValueError, match="layout|permute"):
+        CheckpointManager(os.path.join(str(tmp_path), "c"),
+                          async_save=False, layout_stamp=circ)
+
+    del state
+
+
+def test_repack_stacked_params_roundtrip():
+    """circular->network->circular repacking is the identity, and a
+    circular-stored stack repacked to network order equals the inverse
+    permutation of circular_layer_order."""
+    from distributed_resnet_tensorflow_tpu.models.pipeline import (
+        circular_layer_order, repack_stacked_params)
+    depth, P, v = 8, 2, 2
+    rng = np.random.RandomState(0)
+    net = {"w": rng.randn(depth, 3).astype(np.float32),
+           "b": rng.randn(depth).astype(np.float32)}
+    order = circular_layer_order(depth, P, v)
+    stored = {k: np.asarray(a)[order] for k, a in net.items()}
+    # stored (circular) -> network order
+    back = repack_stacked_params(stored, depth, src=(P, v), dst=(1, 1))
+    for k in net:
+        np.testing.assert_array_equal(np.asarray(back[k]), net[k])
+    # network -> circular == the stored layout
+    fwd = repack_stacked_params(net, depth, src=(1, 1), dst=(P, v))
+    for k in net:
+        np.testing.assert_array_equal(np.asarray(fwd[k]), stored[k])
