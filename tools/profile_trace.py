@@ -23,56 +23,75 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def capture(bs: int, k: int, sub: int, logdir: str):
+def capture(bs: int, k: int, sub: int, logdir: str) -> int:
+    """Trace the fused k-step dispatch; returns the number of optimizer
+    steps inside the traced window."""
     from profile_imagenet_bn import build_step
     trainer, multi_fn, batch, _one = build_step(bs, k, stat_subsample=sub)
     state = trainer.state
     for _ in range(2):  # compile + warm
         state, _ = multi_fn(state, batch)
     jax.block_until_ready(state.params)
+    dispatches = 2
     with jax.profiler.trace(logdir):
-        for _ in range(2):
+        for _ in range(dispatches):
             state, _ = multi_fn(state, batch)
         jax.block_until_ready(state.params)
+    return dispatches * k
 
 
 def op_table(logdir: str, top: int):
-    """xplane → [(op name, category, self_time_us, occurrences)] sorted."""
-    from tensorboard_plugin_profile.convert import raw_to_tool_data
-    xplanes = glob.glob(os.path.join(
-        logdir, "plugins/profile/*/*.xplane.pb"))
+    """xplane → [{op family, category, device_us, occurrences}] sorted.
+
+    Parses the XSpace proto directly (the tensorboard_plugin_profile
+    converter is binary-incompatible with this image's protobuf/TF pairing):
+    the TPU plane's "XLA Ops" line carries one event per HLO-op execution
+    with device_duration_ps + an hlo_category stat. Ops are grouped into
+    families by stripping the trailing ".N" instance suffix — the level the
+    perf doc reasons at (fusion.*, multiply_reduce_fusion.*, ...)."""
+    import re
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    xplanes = sorted(glob.glob(os.path.join(
+        logdir, "plugins/profile/*/*.xplane.pb")))
     if not xplanes:
         raise FileNotFoundError(f"no xplane under {logdir}")
-    data, _ = raw_to_tool_data.xspace_to_tool_data(
-        [xplanes[-1]], "hlo_stats", {})
-    if isinstance(data, bytes):
-        data = data.decode()
-    payload = json.loads(data)
-    # hlo_stats: a GViz table; rows of [..columns..]
-    cols = [c["label"] for c in payload[0]["cols"]] \
-        if isinstance(payload, list) else [c["label"] for c in payload["cols"]]
-    rows = payload[0]["rows"] if isinstance(payload, list) else payload["rows"]
-
-    def col(name):
-        for i, c in enumerate(cols):
-            if name.lower() in c.lower():
-                return i
-        return None
-    i_cat = col("category")
-    i_name = col("HLO op name") or col("name")
-    i_self = col("Total self time (us)") or col("self time")
-    i_occ = col("occurrences")
-    out = []
-    for r in rows:
-        c = [x.get("v") if isinstance(x, dict) else x for x in r["c"]]
-        out.append({
-            "category": c[i_cat] if i_cat is not None else "",
-            "op": c[i_name] if i_name is not None else "",
-            "self_us": float(c[i_self] or 0) if i_self is not None else 0.0,
-            "n": c[i_occ] if i_occ is not None else "",
-        })
+    space = xplane_pb2.XSpace()
+    with open(xplanes[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    tpu = next((p for p in space.planes
+                if p.name.startswith("/device:TPU")), None)
+    if tpu is None:
+        raise RuntimeError(
+            f"no TPU plane in {xplanes[-1]} "
+            f"({[p.name for p in space.planes]})")
+    line = next((l for l in tpu.lines if l.name == "XLA Ops"), None)
+    if line is None:
+        raise RuntimeError(f"no 'XLA Ops' line ({[l.name for l in tpu.lines]})")
+    smeta, emeta = tpu.stat_metadata, tpu.event_metadata
+    fams = {}
+    for ev in line.events:
+        md = emeta[ev.metadata_id]
+        fam = re.sub(r"\.\d+$", "", md.display_name or md.name)
+        cat = ""
+        dur_ps = ev.duration_ps
+        for st in list(ev.stats) + list(md.stats):
+            name = smeta[st.metadata_id].name
+            if name == "hlo_category":
+                cat = st.str_value or (
+                    smeta[st.ref_value].name if st.ref_value else "")
+            elif name == "device_duration_ps" and st.int64_value:
+                dur_ps = st.int64_value
+        if cat == "while":
+            # the enclosing scan loop: its duration INCLUDES every child op
+            # below — totals, not self time
+            continue
+        agg = fams.setdefault((cat, fam), [0, 0])
+        agg[0] += dur_ps
+        agg[1] += 1
+    out = [{"category": c, "op": f, "self_us": ps / 1e6, "n": n}
+           for (c, f), (ps, n) in fams.items()]
     out.sort(key=lambda d: -d["self_us"])
-    return cols, out[:top]
+    return out[:top]
 
 
 def main():
@@ -84,17 +103,25 @@ def main():
     ap.add_argument("--logdir", default="/tmp/drt_trace")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
-    capture(args.bs, args.k, args.sub, args.logdir)
-    cols, table = op_table(args.logdir, args.top)
-    total = sum(d["self_us"] for d in table)
+    steps = capture(args.bs, args.k, args.sub, args.logdir)
+    table = op_table(args.logdir, args.top)
     print(f"top-{args.top} HLO ops by self time "
           f"(bs={args.bs}, k={args.k}, stat_subsample={args.sub}):")
     for d in table:
         print(f"{d['self_us']:>10.0f} us  {d['category']:<22} "
               f"{str(d['op'])[:70]}")
+    total_ms = sum(d["self_us"] for d in table) / steps / 1e3
+    print(f"sum of top-{args.top} ≈ {total_ms:.1f} ms/step "
+          "(sanity vs measured step time)")
+    for d in table:
+        d["ms_per_step"] = round(d["self_us"] / steps / 1e3, 3)
     if args.out:
         with open(args.out, "w") as f:
             json.dump({"bs": args.bs, "k": args.k, "sub": args.sub,
+                       "steps_traced": steps,
+                       "note": "device self time per HLO-op family; the "
+                               "enclosing scan `while` (= sum of children) "
+                               "is excluded",
                        "table": table}, f, indent=2)
         print(f"wrote {args.out}")
 
