@@ -34,7 +34,13 @@ equivalents are (a) this circular schedule, which attacks the bubble
 directly, and (b) ``remat=True``, which bounds the per-tick residual to the
 stage inputs that reverse-mode scan transposition must keep — the same
 stage-boundary stash 1F1B keeps, held for the whole step rather than P
-ticks. Both compose.
+ticks. Both compose. Measured at fixed global batch (compiled temp bytes per
+device, ``tools/pipeline_memory.py`` → ``docs/pipeline_memory_r3.json``):
+remat bounds the stash ~10× (738→65 MB at P=4, M=4); at EQUAL bubble the
+circular schedule matches GPipe's activation memory (555 MB at v=2, M=4 vs
+552 MB at v=1, M=8, both bubble 0.273) while running v× larger microbatches
+— the bubble knob that does not shrink the per-tick MXU work — and extends
+the reachable bubble floor past where GPipe's microbatches hit size 1.
 
 The block math mirrors ``transformer.EncoderBlock`` op-for-op (pre-LN MHA +
 pre-LN MLP with residuals) but is written against explicit stacked params so

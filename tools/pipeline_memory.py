@@ -1,0 +1,105 @@
+"""Compiled-memory receipts for the pipeline schedules.
+
+XLA's whole-program model means pipeline "memory behavior" is decided at
+compile time — so it can be MEASURED at compile time: this tool compiles the
+encoder's value_and_grad over a fake dp×pp mesh for a grid of
+(schedule, remat, microbatch count) and records
+``compiled.memory_analysis().temp_size_in_bytes`` (activations + workspace).
+
+Global batch is FIXED across the whole grid (microbatch size = B/M) so the
+rows isolate the schedule, not the batch. What the grid substantiates
+(models/pipeline.py module docstring):
+  * ``remat=True`` bounds the activation stash (the per-tick residual drops
+    to the stage inputs that scan transposition must keep) — the XLA-native
+    stand-in for 1F1B's eager-backward memory bound,
+  * at fixed batch the non-remat stash is ~flat in M (it is the B·t·d
+    stage-boundary stash), so the bubble knobs are: raise M (smaller
+    microbatches, less per-tick MXU work) or raise interleave v (same
+    microbatch size, v× more ICI hops) — the circular schedule trades
+    neither in memory, costing only its O(B·t·d) wrap queue.
+
+Usage:  python tools/pipeline_memory.py [--out docs/pipeline_memory_r3.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+DIM, DEPTH, HEADS, TOKENS = 256, 8, 8, 128
+DATA, PIPE = 2, 4
+BATCH = 64  # global batch, fixed across the grid (divisible by DATA * max M)
+
+
+def compile_case(mesh, microbatches: int, interleave: int, remat: bool):
+    from distributed_resnet_tensorflow_tpu.models.pipeline import (
+        PipelinedEncoder)
+    b = BATCH
+    enc = PipelinedEncoder(depth=DEPTH, num_heads=HEADS, dtype=jnp.float32,
+                           mesh=mesh, microbatches=microbatches,
+                           interleave=interleave, remat=remat)
+    x = jnp.zeros((b, TOKENS, DIM), jnp.float32)
+    params = enc.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss(p, xx):
+        return (enc.apply({"params": p}, xx) ** 2).sum()
+
+    lowered = jax.jit(jax.value_and_grad(loss)).lower(params, x)
+    ma = lowered.compile().memory_analysis()
+    return {
+        "batch": b,
+        "temp_mb": round(ma.temp_size_in_bytes / 2**20, 2),
+        "args_mb": round(ma.argument_size_in_bytes / 2**20, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+    mesh = create_mesh(MeshConfig(data=DATA, pipeline=PIPE))
+
+    grid = []
+    for sched, v in (("gpipe", 1), ("circular", 2)):
+        for remat in (False, True):
+            for m in (4, 8, 16):
+                row = {"schedule": sched, "interleave": v, "remat": remat,
+                       "microbatches": m,
+                       "bubble": round((PIPE - 1) / (v * m + PIPE - 1), 3)}
+                row.update(compile_case(mesh, m, v, remat))
+                grid.append(row)
+                print({k: row[k] for k in
+                       ("schedule", "remat", "microbatches", "bubble",
+                        "temp_mb")})
+
+    out = {
+        "workload": {"dim": DIM, "depth": DEPTH, "heads": HEADS,
+                     "tokens": TOKENS, "mesh": {"data": DATA, "pipeline": PIPE},
+                     "global_batch": BATCH,
+                     "dtype": "float32", "backend": "cpu (fake 8-device mesh; "
+                     "temp bytes are backend-portable HLO buffer sizes)"},
+        "metric": "compiled.memory_analysis().temp_size_in_bytes per device",
+        "grid": grid,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,9 +1,9 @@
 """Per-op TPU profile of the ImageNet ResNet-50 train step.
 
-Captures a jax.profiler trace of the fused train dispatch and converts the
-xplane via tensorboard_plugin_profile into an HLO-op time breakdown — the
-auditable evidence behind docs/perf_imagenet_r3.md (the reference kept its
-perf story in README tables; this is the TPU analog with per-op receipts).
+Captures a jax.profiler trace of the fused train dispatch and parses the
+xplane proto directly into an HLO-op time breakdown — the auditable
+evidence behind docs/perf_imagenet_r3.md (the reference kept its perf story
+in README tables; this is the TPU analog with per-op receipts).
 
     python tools/profile_trace.py [--bs 128] [--k 8] [--sub 1] [--top 25]
 """
