@@ -92,12 +92,9 @@ def test_pipelined_encoder_matches_sequential():
                                    rtol=3e-3, atol=3e-4)
 
 
-def test_pipelined_vit_through_trainer():
-    """mesh.pipeline > 1 routes the ViT encoder through the GPipe path via
-    the Trainer; training runs and stays finite."""
-    from distributed_resnet_tensorflow_tpu.data import (
-        learnable_synthetic_iterator)
-    from distributed_resnet_tensorflow_tpu.train import Trainer
+def _smoke_vit_cfg(**overrides):
+    """Shared tiny-ViT Trainer config for the pipeline smoke tests; mesh
+    axes / schedule knobs come in via overrides."""
     from distributed_resnet_tensorflow_tpu.utils.config import get_preset
     cfg = get_preset("smoke")
     cfg.model.name = "vit"
@@ -108,10 +105,20 @@ def test_pipelined_vit_through_trainer():
     cfg.model.vit_heads = 2
     cfg.data.image_size = 8
     cfg.train.batch_size = 8
-    cfg.mesh.data = 2
-    cfg.mesh.pipeline = 4
-    cfg.model.vit_pipeline_microbatches = 4  # local batch 4 → mb of 1
     cfg.optimizer.weight_decay = 0.0
+    for k, v in overrides.items():
+        cfg.override(k, v)
+    return cfg
+
+
+def test_pipelined_vit_through_trainer():
+    """mesh.pipeline > 1 routes the ViT encoder through the GPipe path via
+    the Trainer; training runs and stays finite."""
+    from distributed_resnet_tensorflow_tpu.data import (
+        learnable_synthetic_iterator)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    cfg = _smoke_vit_cfg(**{"mesh.data": 2, "mesh.pipeline": 4,
+                            "model.vit_pipeline_microbatches": 4})
     tr = Trainer(cfg)
     tr.init_state()
     state, m = tr.train(learnable_synthetic_iterator(8, 8, 4), num_steps=2)
@@ -178,21 +185,9 @@ def test_pipelined_vit_tp_through_trainer():
     from distributed_resnet_tensorflow_tpu.data import (
         learnable_synthetic_iterator)
     from distributed_resnet_tensorflow_tpu.train import Trainer
-    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
-    cfg = get_preset("smoke")
-    cfg.model.name = "vit"
-    cfg.model.num_classes = 4
-    cfg.model.compute_dtype = "float32"
-    cfg.model.vit_dim = 32
-    cfg.model.vit_depth = 4
-    cfg.model.vit_heads = 2
-    cfg.data.image_size = 8
-    cfg.train.batch_size = 8
-    cfg.mesh.data = 2
-    cfg.mesh.pipeline = 2
-    cfg.mesh.tensor = 2
-    cfg.model.vit_pipeline_microbatches = 4
-    cfg.optimizer.weight_decay = 0.0
+    cfg = _smoke_vit_cfg(**{"mesh.data": 2, "mesh.pipeline": 2,
+                            "mesh.tensor": 2,
+                            "model.vit_pipeline_microbatches": 4})
     tr = Trainer(cfg)
     tr.init_state()
     # stacked params actually sharded over pipeline AND tensor
@@ -311,3 +306,21 @@ def test_circular_requires_enough_microbatches():
     x = jnp.zeros((8, 8, 32), jnp.float32)
     with pytest.raises(ValueError, match="interleave"):
         enc.init(jax.random.PRNGKey(0), x)
+
+
+def test_circular_vit_through_trainer():
+    """model.vit_pipeline_interleave=2 routes the ViT encoder through the
+    circular schedule via the Trainer config path (dp x pp x tp mesh);
+    training runs and stays finite."""
+    from distributed_resnet_tensorflow_tpu.data import (
+        learnable_synthetic_iterator)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    cfg = _smoke_vit_cfg(**{
+        "mesh.data": 2, "mesh.pipeline": 2, "mesh.tensor": 2,
+        "model.vit_pipeline_microbatches": 4,  # local batch 4 -> mb of 1
+        "model.vit_pipeline_interleave": 2})   # depth 4 = 2 stages x 2 chunks
+    tr = Trainer(cfg)
+    tr.init_state()
+    state, m = tr.train(learnable_synthetic_iterator(8, 8, 4), num_steps=2)
+    assert int(state.step) == 2
+    assert np.isfinite(float(m["loss"]))

@@ -29,11 +29,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (  # noqa: E402
+    apply_virtual_cpu, force_cpu_platform)
+
+apply_virtual_cpu(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform()
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
