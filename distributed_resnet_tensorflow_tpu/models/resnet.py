@@ -46,18 +46,24 @@ IMAGENET_MODEL_PARAMS = {
 }
 
 
-def fixed_padding(x: jax.Array, kernel_size: int) -> jax.Array:
-    """Explicit pad so strided convs are input-size independent
-    (reference resnet_model_official.py:53-77)."""
-    pad_total = kernel_size - 1
-    pad_beg = pad_total // 2
-    pad_end = pad_total - pad_beg
-    return jnp.pad(x, ((0, 0), (pad_beg, pad_end), (pad_beg, pad_end), (0, 0)))
+# Round-4 negative result (docs/perf_imagenet_r4.md): re-expressing the
+# stem 3x3/2 max pool as an elementwise max over the 9 shifted strided
+# views — to replace the backward's serial select_and_scatter (1.3 ms/step,
+# docs/perf_imagenet_r3_ops.json) with fusable masks — measured 12 ms/step
+# WORSE (57.5 vs 45.0 ms): the nine [N,114,114,64] mask+pad passes the
+# autodiff produces cost ~10x the op they remove. reduce_window stays.
 
 
 class ConvFixedPadding(nn.Module):
     """Conv with SAME padding for stride 1, explicit fixed padding otherwise
-    (reference resnet_model_official.py:80-91)."""
+    (reference resnet_model_official.py:80-91).
+
+    The fixed padding is folded into the conv op's own low/high padding
+    rather than materialized as a separate ``jnp.pad`` — numerically
+    identical (conv with explicit padding == pad + VALID by definition of
+    ``lax.conv_general_dilated``) but it removes a standalone ``pad`` HLO
+    per strided conv that XLA was executing unfused (measured 0.6 ms/step
+    on ImageNet RN50 bs128, docs/perf_imagenet_r3_ops.json)."""
 
     filters: int
     kernel_size: int
@@ -68,17 +74,74 @@ class ConvFixedPadding(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         if self.strides > 1:
-            x = fixed_padding(x, self.kernel_size)
+            pad_total = self.kernel_size - 1
+            pad = (pad_total // 2, pad_total - pad_total // 2)
+            padding = (pad, pad)
+        else:
+            padding = "SAME"
         return nn.Conv(
             self.filters,
             (self.kernel_size, self.kernel_size),
             strides=(self.strides, self.strides),
-            padding="SAME" if self.strides == 1 else "VALID",
+            padding=padding,
             use_bias=False,
             kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal"),
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )(x)
+
+
+class StemConv(nn.Module):
+    """The ImageNet 7x7/2 stem conv, optionally evaluated via
+    space-to-depth.
+
+    The plain formulation gives the MXU a contraction of 7·7·3 with only
+    3 input channels — a shape XLA tiles poorly. With
+    ``space_to_depth=True`` the SAME arithmetic is re-expressed: the input
+    is rearranged [N,224,224,3] → [N,115,115,12] (2×2 pixel blocks into
+    channels) and the kernel [7,7,3,F] → [4,4,12,F] (zero-padded to 8 taps,
+    split even/odd), turning the stem into a 4×4/1 conv whose taps align
+    with the block grid. Weights are stored in the canonical [7,7,3,F]
+    layout either way, so checkpoints are mode-portable. Equivalence is
+    exact in math (same multiply-adds, reassociated) and pinned by
+    tests/test_models.py::test_stem_space_to_depth_parity.
+    """
+
+    filters: int = 64
+    space_to_depth: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        f = self.filters
+        w = self.param(
+            "kernel",
+            nn.initializers.variance_scaling(2.0, "fan_out",
+                                             "truncated_normal"),
+            (7, 7, 3, f), self.param_dtype)
+        dn = ("NHWC", "HWIO", "NHWC")
+        if not self.space_to_depth:
+            return jax.lax.conv_general_dilated(
+                x, w.astype(self.dtype), (2, 2), ((3, 3), (3, 3)),
+                dimension_numbers=dn)
+        n, h, wd, c = x.shape
+        if h % 2 or wd % 2 or c != 3:
+            raise ValueError(
+                f"space-to-depth stem needs even HxW RGB input, got {x.shape}")
+        # kernel: zero tap at the BEGINNING of each spatial dim (k 7→8), so
+        # with input padding (4, 2) every tap p = 2·out + a lands at
+        # s2d cell (out + a//2, a%2) — a VALID 4×4 conv over the s2d grid
+        w8 = jnp.pad(w, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w4 = w8.reshape(4, 2, 4, 2, 3, f).transpose(0, 2, 1, 3, 4, 5) \
+               .reshape(4, 4, 12, f)
+        xp = jnp.pad(x, ((0, 0), (4, 2), (4, 2), (0, 0)))
+        hs, ws = (h + 6) // 2, (wd + 6) // 2
+        xs = xp.reshape(n, hs, 2, ws, 2, c).transpose(0, 1, 3, 2, 4, 5) \
+               .reshape(n, hs, ws, 4 * c)
+        return jax.lax.conv_general_dilated(
+            xs, w4.astype(self.dtype), (1, 1), "VALID",
+            dimension_numbers=dn)
 
 
 class BatchNormRelu(nn.Module):
@@ -274,6 +337,7 @@ class ImageNetResNetV2(nn.Module):
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
     bn_stat_subsample: int = 1
+    stem_space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -285,9 +349,14 @@ class ImageNetResNetV2(nn.Module):
         block_cls = BottleneckBlock if block_kind == "bottleneck" else BuildingBlock
 
         x = x.astype(self.dtype)
-        x = ConvFixedPadding(64, 7, 2, dtype=self.dtype)(x)
-        x = fixed_padding(x, 3)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = StemConv(64, space_to_depth=self.stem_space_to_depth,
+                     dtype=self.dtype)(x)
+        # reference semantics: tf.layers.max_pooling2d(..., padding='SAME')
+        # (resnet_model_official.py:314-316) — SAME maxpool pads with -inf
+        # (padding never wins the max) and at 112/2 pads (0,1), NOT the
+        # zero-pad (1,1) this model used through round 3; SAME is both the
+        # faithful geometry and one fused op cheaper (no standalone pad HLO)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, num_blocks in enumerate(block_counts):
             x = BlockLayer(
                 block_cls=block_cls, filters=64 * (2 ** i), num_blocks=num_blocks,
@@ -358,7 +427,8 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
             num_classes=model_cfg.num_classes,
             dtype=dtype, axis_name=axis_name, bn_groups=bn_groups, remat=remat,
             bn_momentum=model_cfg.bn_momentum, bn_epsilon=model_cfg.bn_epsilon,
-            bn_stat_subsample=model_cfg.bn_stat_subsample)
+            bn_stat_subsample=model_cfg.bn_stat_subsample,
+            stem_space_to_depth=model_cfg.stem_space_to_depth)
     raise ValueError(f"unknown dataset {dataset!r}")
 
 

@@ -370,12 +370,13 @@ class Trainer:
         del k
         if self._jitted_multi is None:
             step = self._train_step
+            unroll = max(1, self.cfg.train.scan_unroll)
 
             def multi(state, batches):
                 def body(s, batch):
                     s, m = step(s, batch)
                     return s, m
-                state, ms = jax.lax.scan(body, state, batches)
+                state, ms = jax.lax.scan(body, state, batches, unroll=unroll)
                 last = jax.tree_util.tree_map(lambda x: x[-1], ms)
                 return state, last
 
@@ -482,11 +483,12 @@ class Trainer:
         if self._jitted_idx_multi is None:
             from ..parallel.mesh import replicated
             gathered = self._gathered_step()
+            unroll = max(1, self.cfg.train.scan_unroll)
 
             def multi(state, batches, images, labels):
                 def body(s, batch):
                     return gathered(s, batch, images, labels)
-                state, ms = jax.lax.scan(body, state, batches)
+                state, ms = jax.lax.scan(body, state, batches, unroll=unroll)
                 last = jax.tree_util.tree_map(lambda x: x[-1], ms)
                 return state, last
 

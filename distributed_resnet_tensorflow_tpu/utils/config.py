@@ -41,6 +41,12 @@ class ModelConfig:
     # (ops/batch_norm.py module docstring has the measured story). 1 = exact
     # moments (default everywhere; reference numerics).
     bn_stat_subsample: int = 1
+    # evaluate the ImageNet 7x7/2 stem via space-to-depth (input [N,224,224,3]
+    # -> [N,115,115,12], kernel 7x7x3 -> 4x4x12): mathematically the same
+    # conv, but the contraction no longer has the MXU-hostile 3-channel
+    # input. Measured +2.7% img/s on RN50 bs128 (docs/perf_imagenet_r4.md);
+    # parity pinned by tests/test_models.py::test_stem_space_to_depth_parity.
+    stem_space_to_depth: bool = True
     # toy MLP (reference logist_model.py:10-11)
     hidden_units: int = 100
     input_size: int = 32 * 32 * 3
@@ -59,8 +65,10 @@ class ModelConfig:
     vit_num_experts: int = 0
     vit_expert_capacity_factor: float = 1.25
     vit_moe_top_k: int = 1            # 1 = Switch; 2 = GShard-style top-2
-    # auto = gather (O(N+EC)) off the expert mesh axis, one-hot einsum on it
-    vit_moe_dispatch: str = "auto"    # auto | einsum | gather
+    # auto = gather (O(N+EC)) off the expert mesh axis; hand-scheduled
+    # shard_map + lax.all_to_all exchange on it (einsum fallback when the
+    # token count doesn't divide over the batch x expert shards)
+    vit_moe_dispatch: str = "auto"    # auto | einsum | gather | a2a
     moe_aux_weight: float = 0.01      # Switch load-balancing loss weight
     # auto = ring if mesh.sequence>1; flash on TPU at >=2048 tokens; else dense
     attention_impl: str = "auto"      # auto | dense | blockwise | flash | ring
@@ -152,6 +160,13 @@ class TrainConfig:
     # Amortizes host dispatch — the TPU analog of TPUEstimator's
     # iterations_per_loop. Hooks/logging fire at loop boundaries.
     steps_per_loop: int = 1
+    # unroll factor for the steps_per_loop lax.scan. The while-loop form
+    # double-buffers the ~430-leaf TrainState carry on TPU (~1.1k tiny
+    # async copies/step, measured 2.5 ms/step on ImageNet RN50 bs128 —
+    # docs/perf_imagenet_r4.md); full unroll (scan_unroll >= steps_per_loop)
+    # removes the loop so the state updates in place. Cost: program size and
+    # compile time scale with the factor.
+    scan_unroll: int = 1
     # Pallas fused softmax-xent kernel in the train loss (replaces the
     # reference's fused TF op, resnet_model.py:78-80):
     # auto = on iff TPU | on | interpret (CPU tests) | off

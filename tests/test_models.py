@@ -121,3 +121,32 @@ def test_create_model_factory():
     assert isinstance(m2, ImageNetResNetV2)
     cfg3 = ModelConfig(name="logistic")
     assert isinstance(create_model(cfg3, "cifar10"), LogisticNet)
+
+
+def test_stem_space_to_depth_parity():
+    """StemConv(space_to_depth=True) computes the same conv as the plain
+    7x7/2 stem — same params (mode-portable checkpoints), reassociated
+    arithmetic only (fp32 here, so near-exact)."""
+    from distributed_resnet_tensorflow_tpu.models.resnet import StemConv
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3).astype(np.float32))
+    plain = StemConv(16, space_to_depth=False, dtype=jnp.float32)
+    s2d = StemConv(16, space_to_depth=True, dtype=jnp.float32)
+    variables = plain.init(jax.random.PRNGKey(0), x)
+    y_plain = plain.apply(variables, x)
+    y_s2d = s2d.apply(variables, x)  # same param tree
+    assert y_plain.shape == (2, 16, 16, 16)
+    assert y_s2d.shape == y_plain.shape
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_plain),
+                               rtol=1e-5, atol=1e-5)
+
+    # grads agree too (the transform is linear in both x and w)
+    def loss(mode):
+        m = StemConv(16, space_to_depth=mode, dtype=jnp.float32)
+        return lambda v: jnp.sum(m.apply(v, x) ** 2)
+    g_plain = jax.grad(loss(False))(variables)
+    g_s2d = jax.grad(loss(True))(variables)
+    np.testing.assert_allclose(
+        np.asarray(g_s2d["params"]["kernel"]),
+        np.asarray(g_plain["params"]["kernel"]), rtol=1e-4, atol=1e-4)

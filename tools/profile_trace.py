@@ -68,10 +68,15 @@ def op_table(logdir: str, top: int):
     if line is None:
         raise RuntimeError(f"no 'XLA Ops' line ({[l.name for l in tpu.lines]})")
     smeta, emeta = tpu.stat_metadata, tpu.event_metadata
+    # control-flow container ops whose duration INCLUDES every child op
+    # below them — counting any of them would double the totals
+    container = {"while", "conditional", "call", "control-flow"}
     fams = {}
+    insts = {}
     for ev in line.events:
         md = emeta[ev.metadata_id]
-        fam = re.sub(r"\.\d+$", "", md.display_name or md.name)
+        name_full = md.display_name or md.name
+        fam = re.sub(r"\.\d+$", "", name_full)
         cat = ""
         dur_ps = ev.duration_ps
         for st in list(ev.stats) + list(md.stats):
@@ -81,17 +86,21 @@ def op_table(logdir: str, top: int):
                     smeta[st.ref_value].name if st.ref_value else "")
             elif name == "device_duration_ps" and st.int64_value:
                 dur_ps = st.int64_value
-        if cat == "while":
-            # the enclosing scan loop: its duration INCLUDES every child op
-            # below — totals, not self time
+        if cat in container:
             continue
         agg = fams.setdefault((cat, fam), [0, 0])
         agg[0] += dur_ps
         agg[1] += 1
+        iagg = insts.setdefault((cat, name_full), [0, 0])
+        iagg[0] += dur_ps
+        iagg[1] += 1
     out = [{"category": c, "op": f, "self_us": ps / 1e6, "n": n}
            for (c, f), (ps, n) in fams.items()]
     out.sort(key=lambda d: -d["self_us"])
-    return out[:top]
+    iout = [{"category": c, "op": f, "self_us": ps / 1e6, "n": n}
+            for (c, f), (ps, n) in insts.items()]
+    iout.sort(key=lambda d: -d["self_us"])
+    return out[:top], iout[:top]
 
 
 def main():
@@ -104,7 +113,7 @@ def main():
     ap.add_argument("--out", default="")
     args = ap.parse_args()
     steps = capture(args.bs, args.k, args.sub, args.logdir)
-    table = op_table(args.logdir, args.top)
+    table, instances = op_table(args.logdir, args.top)
     print(f"top-{args.top} HLO ops by self time "
           f"(bs={args.bs}, k={args.k}, stat_subsample={args.sub}):")
     for d in table:
@@ -113,16 +122,21 @@ def main():
     total_ms = sum(d["self_us"] for d in table) / steps / 1e3
     print(f"sum of top-{args.top} ≈ {total_ms:.1f} ms/step "
           "(sanity vs measured step time)")
-    for d in table:
+    print(f"\ntop-{args.top} individual op instances:")
+    for d in instances:
+        print(f"{d['self_us']:>10.0f} us  n={d['n']:<6} {d['category']:<20} "
+              f"{str(d['op'])[:70]}")
+    for d in table + instances:
         d["ms_per_step"] = round(d["self_us"] / steps / 1e3, 3)
     if args.out:
         with open(args.out, "w") as f:
             json.dump({"bs": args.bs, "k": args.k, "sub": args.sub,
                        "steps_traced": steps,
-                       "note": "device self time per HLO-op family; the "
-                               "enclosing scan `while` (= sum of children) "
-                               "is excluded",
-                       "table": table}, f, indent=2)
+                       "note": "device self time per HLO-op family; "
+                               "control-flow container ops (while/"
+                               "conditional/call = sum of children) "
+                               "are excluded",
+                       "table": table, "instances": instances}, f, indent=2)
         print(f"wrote {args.out}")
 
 
