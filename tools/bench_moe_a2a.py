@@ -1,0 +1,110 @@
+"""Sharded-expert dispatch comparison on the virtual 8-device mesh
+(VERDICT r3 #3): one-hot einsum (GSPMD collectives) vs hand-scheduled
+all-to-all (shard_map + lax.all_to_all), with the unsharded gather path as
+the floor.
+
+Runs on the fake 8-CPU mesh — the only >1-device surface in this
+environment — so the numbers compare the COMMUNICATION/MEMORY structure of
+the formulations, not TPU kernel speed (single-chip TPU numbers live in
+docs/moe_r3.json via tools/bench_moe.py, where no expert axis exists to
+shard over). Token budget matches the r3 bench: 8,192 tokens/step.
+
+    python tools/bench_moe_a2a.py          # writes docs/moe_r4.json
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def build(mesh_axes: dict, dispatch: str, num_experts=8, top_k=1,
+          bs=32, image=64, patch=4, k=1, depth=2):
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import (
+        shard_stacked_batch)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+    cfg = get_preset("smoke")
+    cfg.model.name = "vit"
+    cfg.model.num_classes = 16
+    cfg.model.vit_dim = 256
+    cfg.model.vit_depth = depth  # shallow: the 1-core host pays XLA per
+    # layer and the dispatch-formulation difference is per-MoE-block
+    cfg.model.vit_heads = 4
+    cfg.model.vit_num_experts = num_experts
+    cfg.model.vit_moe_top_k = top_k
+    cfg.model.vit_moe_dispatch = dispatch
+    cfg.data.image_size = image
+    cfg.model.vit_patch_size = patch
+    cfg.train.batch_size = bs
+    cfg.train.steps_per_loop = k
+    for a, v in mesh_axes.items():
+        setattr(cfg.mesh, a, v)
+    tr = Trainer(cfg)
+    tr.init_state()
+    fn = tr.jitted_multi_step(k)
+    rng = np.random.RandomState(0)
+    batch = shard_stacked_batch({
+        "images": rng.randn(k, bs, image, image, 3).astype(np.float32),
+        "labels": rng.randint(0, 16, (k, bs)).astype(np.int32),
+    }, tr.mesh)
+    return tr, fn, batch, k
+
+
+def ms_per_step(tr, fn, batch, k, loops=3, reps=3):
+    state = tr.state
+    for _ in range(2):
+        state, _ = fn(state, batch)
+    jax.block_until_ready(state.params)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(loops):
+            state, _ = fn(state, batch)
+        jax.block_until_ready(state.params)
+        best = min(best, (time.perf_counter() - t0) / (loops * k))
+    return best * 1e3
+
+
+def main():
+    assert len(jax.devices()) == 8, jax.devices()
+    out = {"device": "virtual 8x cpu (structure comparison; single-chip "
+                     "TPU rows are docs/moe_r3.json)",
+           "tokens_per_batch": 32 * (64 // 4) ** 2, "configs": {}}
+    rows = (
+        # the floor: experts unsharded (all 8 devices data-parallel)
+        ("dp8_gather_unsharded", {"data": 8}, "gather"),
+        # sharded expert axis: GSPMD one-hot einsum vs hand-scheduled a2a
+        ("dp2_ep4_einsum", {"data": 2, "expert": 4}, "einsum"),
+        ("dp2_ep4_a2a", {"data": 2, "expert": 4}, "a2a"),
+    )
+    for name, axes, disp in rows:
+        tr, fn, batch, k = build(axes, disp)
+        ms = ms_per_step(tr, fn, batch, k)
+        out["configs"][name] = round(ms, 3)
+        print(f"{name:>22}: {ms:8.2f} ms/step", flush=True)
+    c = out["configs"]
+    out["a2a_vs_einsum_dp2ep4"] = round(
+        c["dp2_ep4_einsum"] / c["dp2_ep4_a2a"], 2)
+    out["a2a_vs_unsharded_gather"] = round(
+        c["dp2_ep4_a2a"] / c["dp8_gather_unsharded"], 2)
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "moe_r4.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()
