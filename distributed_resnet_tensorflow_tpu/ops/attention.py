@@ -52,9 +52,17 @@ def _online_block(q, k, v, m, l, acc, scale, mask=None):
     """One flash-attention accumulation step.
 
     q: (B,Tq,H,D); k,v: (B,Tk,H,D); m,l: (B,H,Tq); acc: (B,Tq,H,D);
-    mask: (Tq,Tk) bool or None. All accumulation in fp32.
+    mask: (Tq,Tk) bool or None.
+
+    Matmuls run in the INPUT dtype with fp32 accumulation
+    (``preferred_element_type``): bf16 inputs ride the MXU at full rate
+    (the r3 inner block upcast V to fp32, turning the PV matmul into a
+    multi-pass fp32 MXU op — the main reason the ring underperformed the
+    Pallas kernel, docs/ring_attention_r4.md); fp32 inputs (CPU tests)
+    keep exact-parity semantics. Softmax statistics stay fp32 always.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[None, None], s, -jnp.inf)
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -65,7 +73,8 @@ def _online_block(q, k, v, m, l, acc, scale, mask=None):
         p = jnp.where(mask[None, None], p, 0.0)
     corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
     l_new = l * corr + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
     acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, acc_new
 
@@ -147,17 +156,41 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, causal: bool = False,
                            seq_axis: str = "seq",
-                           batch_axes: tuple = ()) -> jax.Array:
-    """Convenience wrapper: shard_map ring_attention over ``mesh[seq_axis]``
+                           batch_axes: tuple = (),
+                           kernel: str = "auto") -> jax.Array:
+    """Convenience wrapper: shard_map ring attention over ``mesh[seq_axis]``
     with time-dim sharding (B, T/seq, H, D per device).
 
     ``batch_axes`` names mesh axes the batch dim is already split over (e.g.
     ("data",)) so composition with data parallelism keeps the batch sharded
-    instead of all-gathering it at the shard_map boundary."""
+    instead of all-gathering it at the shard_map boundary.
+
+    ``kernel`` picks the per-step inner block: "lax" = the pure-lax online
+    recurrence (any backend); "flash" = the fused Pallas kernel
+    (ops/pallas/flash_attention.ring_flash_attention — measured 1.5×-3.6×
+    faster at 8k-32k tokens, docs/ring_attention_r4.json);
+    "flash_interpret" = the same kernels in the Pallas interpreter (CPU
+    parity tests); "auto" = flash on TPU, lax elsewhere."""
     from ..parallel.mesh import shard_map_compat
 
+    n = mesh.shape[seq_axis]
+    if kernel not in ("auto", "lax", "flash", "flash_interpret"):
+        raise ValueError(f"unknown ring attention kernel {kernel!r}")
+    mode = kernel
+    if mode == "auto":
+        mode = "flash" if jax.default_backend() == "tpu" else "lax"
+
     spec = P(batch_axes or None, seq_axis, None, None)
+    if mode == "lax":
+        body = functools.partial(ring_attention, axis_name=seq_axis,
+                                 causal=causal)
+    else:
+        from .pallas.flash_attention import ring_flash_attention
+        interp = mode == "flash_interpret"
+
+        def body(q, k, v):
+            return ring_flash_attention(q, k, v, seq_axis, n, causal,
+                                        interp)
     fn = shard_map_compat(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
-        mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

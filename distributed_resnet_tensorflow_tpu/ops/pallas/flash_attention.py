@@ -407,3 +407,139 @@ def _fa_bwd(causal, interpret, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention with the Pallas kernel as the inner block (round 4,
+# VERDICT r3 #4). The pure-lax ring (ops/attention.ring_attention) is bound
+# by its O(T²) f32 softmax elementwise traffic — measured 1.5×-3.6× slower
+# than the fused kernel at 8k-32k tokens (docs/ring_attention_r4.json),
+# and re-expressing its matmuls in bf16 measured a wash, so the kernel is
+# the only way to make the sequence-parallel path perf-grade.
+#
+# Forward: per ring step, one _flash_forward call against the resident kv
+# chunk (causal only on the diagonal step); per-chunk (out, lse) pairs are
+# merged with the standard logsumexp combine. Backward: a custom ring —
+# _flash_backward per chunk with the GLOBAL lse (p = exp(s - lse_global)
+# recovers the true softmax slice, the flash-2 decomposition), dq
+# accumulating locally while dk/dv accumulators ride the ring WITH their
+# kv chunks (n hops = home). Causal skips: device `my` executes only ring
+# steps i <= my (lax.cond), the same work skipping the lax ring does.
+# ---------------------------------------------------------------------------
+
+
+def _ring_combine(M, S, A, o_i, lse_i):
+    """Merge one chunk's normalized output into the running combine.
+
+    M/S (B,H,T) running max / rescaled sumexp; A (B,T,H,D) f32 running
+    numerator; o_i chunk output (softmax-normalized within the chunk);
+    lse_i (B,H,T) the chunk's logsumexp."""
+    M_new = jnp.maximum(M, lse_i)
+    w_old = jnp.exp(M - M_new)          # first step: exp(-inf - x) = 0
+    w_new = jnp.exp(lse_i - M_new)
+    A_new = A * w_old.transpose(0, 2, 1)[..., None] \
+        + o_i.astype(jnp.float32) * w_new.transpose(0, 2, 1)[..., None]
+    return M_new, S * w_old + w_new, A_new
+
+
+def _ring_impl(q, k, v, axis_name, n, causal, interpret):
+    """Returns (out, global lse (B,H,T) f32). Call under shard_map."""
+    b, t, h, d = q.shape
+    my = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    bq, bk = _pick_blocks(t, d)
+    M = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    S = jnp.zeros((b, h, t), jnp.float32)
+    A = jnp.zeros((b, t, h, d), jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(n):
+        # ring step i: this device holds kv chunk (my - i) mod n; with
+        # causal masking that chunk is visible iff (my - i) mod n <= my,
+        # i.e. iff i <= my — and i == 0 is always the causal diagonal
+        is_diag = causal and i == 0
+
+        def compute(args, _diag=is_diag):
+            M_, S_, A_, k_c, v_c = args
+            o_i, lse_f = _flash_forward(
+                q, k_c, v_c, causal=_diag, interpret=interpret,
+                block_q=bq, block_k=bk, return_residuals=True)
+            lse_i = lse_f[:, :t, 0].reshape(b, h, t)
+            return _ring_combine(M_, S_, A_, o_i, lse_i)
+
+        args = (M, S, A, k_cur, v_cur)
+        if causal and i > 0:
+            M, S, A = jax.lax.cond(
+                my >= i, compute, lambda a: (a[0], a[1], a[2]), args)
+        else:
+            M, S, A = compute(args)
+        if i < n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    S_safe = jnp.where(S == 0.0, 1.0, S)
+    out = (A / S_safe.transpose(0, 2, 1)[..., None]).astype(v.dtype)
+    return out, M + jnp.log(S_safe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str, axis_size: int,
+                         causal: bool = False,
+                         interpret: bool = False) -> jax.Array:
+    """Sequence-parallel flash attention over mesh axis ``axis_name``
+    (size ``axis_size``) — call under shard_map with q/k/v time-sharded
+    (B, T/n, H, D per device). Differentiable; the backward rides the same
+    ring (see module comment above)."""
+    out, _ = _ring_impl(q, k, v, axis_name, axis_size, causal, interpret)
+    return out
+
+
+def _ring_fa_fwd(q, k, v, axis_name, n, causal, interpret):
+    out, lse = _ring_impl(q, k, v, axis_name, n, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_fa_bwd(axis_name, n, causal, interpret, res, g):
+    q, k, v, out, lse = res
+    b, t, h, d = q.shape
+    my = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    bq, bk = _pick_blocks(t, d)
+    _, _, tpad, _ = _geometry(t, d, bq, bk)
+    lse_f = lse.reshape(b * h, t, 1)
+    if tpad:
+        # pad rows only meet zero-padded dO rows, so any finite value works
+        lse_f = jnp.pad(lse_f, ((0, 0), (0, tpad), (0, 0)))
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_cur = jnp.zeros(k.shape, jnp.float32)
+    dv_cur = jnp.zeros(v.shape, jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(n):
+        is_diag = causal and i == 0
+
+        def compute(args, _diag=is_diag):
+            dq_a, dk_c, dv_c, k_c, v_c = args
+            dqi, dki, dvi = _flash_backward(
+                q, k_c, v_c, out, lse_f, g, causal=_diag,
+                interpret=interpret, block_q=bq, block_k=bk)
+            return (dq_a + dqi.astype(jnp.float32),
+                    dk_c + dki.astype(jnp.float32),
+                    dv_c + dvi.astype(jnp.float32))
+
+        args = (dq, dk_cur, dv_cur, k_cur, v_cur)
+        if causal and i > 0:
+            dq, dk_cur, dv_cur = jax.lax.cond(
+                my >= i, compute, lambda a: (a[0], a[1], a[2]), args)
+        else:
+            dq, dk_cur, dv_cur = compute(args)
+        # rotate kv AND the kv-grad accumulators together on every step —
+        # after n hops each chunk's accumulated (dk, dv) is back home
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+    return (dq.astype(q.dtype), dk_cur.astype(k.dtype),
+            dv_cur.astype(v.dtype))
+
+
+ring_flash_attention.defvjp(_ring_fa_fwd, _ring_fa_bwd)
