@@ -106,17 +106,34 @@ class CheckpointManager:
             # than silently permutes
             return None
 
+    @staticmethod
+    def _strip_meta(stamp):
+        """Layout comparison ignores the bookkeeping key."""
+        if not stamp:
+            return stamp
+        return {k: v for k, v in stamp.items() if k != "applies_from_step"}
+
     def _check_layout(self) -> None:
         cur = self._layout_stamp
         if cur is None:
             return  # caller declared no stacked layout — nothing to enforce
-        if self.latest_step() is None:
+        latest = self.latest_step()
+        if latest is None:
             # no committed checkpoint — an orphaned sidecar (stamp written,
             # save failed) conflicts with nothing and gets overwritten
             return
+        saved = self.saved_layout()
+        if saved is not None:
+            af = saved.get("applies_from_step")
+            if af is not None and af > latest:
+                # the sidecar is written before the (async) orbax commit; a
+                # crash between the two leaves a stamp describing a step
+                # that never landed. Ignore it — the committed checkpoints
+                # all predate it (ADVICE r3 #4)
+                saved = None
         # checkpoints that predate layout stamping could only have been
         # network order
-        saved = self.saved_layout() or {"encoder_order": "network"}
+        saved = self._strip_meta(saved) or {"encoder_order": "network"}
         circular = "circular" in (saved.get("encoder_order"),
                                   cur.get("encoder_order"))
         if circular and saved != cur:
@@ -127,9 +144,12 @@ class CheckpointManager:
                 "models.pipeline.repack_stacked_params, or match "
                 "mesh.pipeline / model.vit_pipeline_interleave")
 
-    def _write_layout(self) -> None:
+    def _write_layout(self, step: int) -> None:
         # chief-only + atomic: every host shares this directory, and
-        # concurrent truncating writes could leave unparseable JSON
+        # concurrent truncating writes could leave unparseable JSON.
+        # ``applies_from_step`` records the first step this stamp describes,
+        # so a stamp orphaned by a crash before the async commit can be
+        # recognized (newer than every committed step) and ignored
         if jax.process_index() != 0:
             return
         import json
@@ -137,7 +157,8 @@ class CheckpointManager:
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".layout")
         try:
             with os.fdopen(fd, "w") as f:
-                json.dump(self._layout_stamp, f)
+                json.dump({**self._layout_stamp, "applies_from_step": step},
+                          f)
             os.replace(tmp, self._layout_path)
         finally:
             if os.path.exists(tmp):
@@ -147,9 +168,15 @@ class CheckpointManager:
         if step in self._mngr.all_steps():
             return  # idempotent: step already checkpointed
         self._check_layout()
-        if self._layout_stamp is not None and (
-                self.saved_layout() != self._layout_stamp):
-            self._write_layout()
+        if self._layout_stamp is not None:
+            saved = self.saved_layout()
+            # rewrite when the layout differs OR the existing stamp's
+            # applies_from_step is ahead of this commit (a crash orphan
+            # from an earlier run; left alone it would outrank every step
+            # this run commits and _check_layout would keep discarding it)
+            if (self._strip_meta(saved) != self._layout_stamp
+                    or (saved or {}).get("applies_from_step", step) > step):
+                self._write_layout(step)
         self._mngr.save(step, args=ocp.args.StandardSave(_saveable(state)),
                         force=force)
         self._last_save_time = time.monotonic()

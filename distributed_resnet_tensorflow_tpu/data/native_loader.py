@@ -45,8 +45,20 @@ def _so_exports(symbol: bytes) -> bool:
     Staleness must be decided before the first ``ctypes.CDLL``: glibc caches
     dlopen handles by device/inode and ``make`` relinks in place, so once the
     old mapping exists a rebuild+re-CDLL hands back the stale symbol table.
-    Exported names live verbatim in .dynstr, so a raw substring scan is a
-    sufficient probe."""
+
+    Asks ``nm -D`` for the dynamic symbol table (exact-token match, so a
+    string literal or archive-member occurrence of the name elsewhere in
+    the file can't report a stale pre-JPEG build as fresh); falls back to
+    a raw substring scan only when binutils is unavailable."""
+    try:
+        out = subprocess.run(["nm", "-D", "--defined-only", _SO_PATH],
+                             capture_output=True, timeout=30)
+        if out.returncode == 0 and out.stdout:
+            return any(line.split()[-1] == symbol.decode()
+                       for line in out.stdout.decode(errors="replace")
+                       .splitlines() if line.split())
+    except Exception:
+        pass
     try:
         with open(_SO_PATH, "rb") as f:
             return symbol in f.read()

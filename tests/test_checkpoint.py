@@ -207,7 +207,9 @@ def test_layout_stamp_mismatch_refused(tmp_path):
                            layout_stamp=circ)
     m1.save(1, S(), force=True)
     m1.wait_until_finished()
-    assert m1.saved_layout() == circ
+    saved = m1.saved_layout()
+    assert saved.pop("applies_from_step") == 1  # crash-orphan bookkeeping
+    assert saved == circ
     m1.close()
 
     # same layout: restore proceeds
@@ -239,8 +241,33 @@ def test_layout_stamp_mismatch_refused(tmp_path):
                                layout_stamp={"encoder_order": "network"})
     m_orph.save(1, S(), force=True)
     m_orph.wait_until_finished()
-    assert m_orph.saved_layout() == {"encoder_order": "network"}
+    assert m_orph.saved_layout()["encoder_order"] == "network"
     m_orph.close()
+
+    # crash orphan OVER existing checkpoints (ADVICE r3 #4): a directory
+    # holding committed network-order steps, then a circular run's save
+    # crashes after the sidecar write but before the orbax commit. The
+    # stamp's applies_from_step (2) is newer than every committed step (1),
+    # so a network-order run must still open the directory.
+    crash_dir = os.path.join(str(tmp_path), "crash")
+    m_net = CheckpointManager(crash_dir, async_save=False,
+                              layout_stamp={"encoder_order": "network"})
+    m_net.save(1, S(), force=True)
+    m_net.wait_until_finished()
+    m_net.close()
+    with open(os.path.join(crash_dir, "layout.json"), "w") as f:
+        json.dump({**circ, "applies_from_step": 2}, f)  # commit never landed
+    m_after = CheckpointManager(crash_dir, async_save=False,
+                                layout_stamp={"encoder_order": "network"})
+    _, step = m_after.restore(S())
+    assert step == 1
+    m_after.close()
+    # ...while a circular run whose stamp DID commit still refuses network
+    with open(os.path.join(crash_dir, "layout.json"), "w") as f:
+        json.dump({**circ, "applies_from_step": 1}, f)
+    with pytest.raises(ValueError, match="layout|permute"):
+        CheckpointManager(crash_dir, async_save=False,
+                          layout_stamp={"encoder_order": "network"})
 
     # a corrupt sidecar next to committed checkpoints refuses loudly for a
     # circular run (conservative network-order assumption), never permutes
@@ -273,3 +300,36 @@ def test_repack_stacked_params_roundtrip():
     fwd = repack_stacked_params(net, depth, src=(1, 1), dst=(P, v))
     for k in net:
         np.testing.assert_array_equal(np.asarray(fwd[k]), stored[k])
+
+
+def test_orphan_stamp_refreshed_on_same_layout_commit(tmp_path):
+    """A crash-orphaned sidecar whose applies_from_step is AHEAD of the
+    steps a rerun commits must be re-stamped at commit time — otherwise
+    every later reader would keep discarding a now-valid stamp and could
+    restore circular params as network order (review r4 finding)."""
+    import json
+
+    class S:
+        step = 0
+        params = {"w": np.arange(4.0)}
+        batch_stats = {}
+        opt_state = {}
+
+        def replace(self, **kw):
+            return self
+
+    circ = {"encoder_order": "circular", "pstages": 4, "interleave": 2,
+            "depth": 8}
+    d = os.path.join(str(tmp_path), "c")
+    os.makedirs(d)
+    with open(os.path.join(d, "layout.json"), "w") as f:
+        json.dump({**circ, "applies_from_step": 50}, f)  # orphan from crash
+    m = CheckpointManager(d, async_save=False, layout_stamp=dict(circ))
+    m.save(1, S(), force=True)
+    m.wait_until_finished()
+    assert m.saved_layout()["applies_from_step"] == 1  # refreshed
+    m.close()
+    # the committed stamp now outranks nothing — a network run refuses
+    with pytest.raises(ValueError, match="layout|permute"):
+        CheckpointManager(d, async_save=False,
+                          layout_stamp={"encoder_order": "network"})

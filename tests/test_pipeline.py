@@ -275,6 +275,48 @@ def test_circular_pipeline_matches_sequential():
                                    rtol=3e-3, atol=3e-4)
 
 
+def test_circular_pipeline_same_tick_store_consume():
+    """M == P — the tightest legal circular case (ADVICE r3 #1): the wrap
+    queue's store and consume land on the SAME tick, so correctness
+    depends on the store preceding the parked read inside tick(). Full
+    fwd+grad parity at pstages=4, microbatches=4, interleave=2 (depth 8,
+    dp=2 x pp=4) pins that ordering against regressions."""
+    from distributed_resnet_tensorflow_tpu.models.pipeline import (
+        circular_layer_order)
+    depth, pstages, v = 8, 4, 2
+    mesh = _mesh(data=2, pipeline=4)
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, 8, 32).astype(np.float32))
+
+    enc_seq = PipelinedEncoder(depth=depth, num_heads=4, dtype=jnp.float32,
+                               mesh=None)
+    enc_cc = PipelinedEncoder(depth=depth, num_heads=4, dtype=jnp.float32,
+                              mesh=mesh, microbatches=4, interleave=v)
+    variables = enc_seq.init(jax.random.PRNGKey(0), x)
+    order = circular_layer_order(depth, pstages, v)
+    cc_params = _permute_stack(variables["params"], order)
+
+    def loss(enc):
+        def fn(params, x):
+            y = enc.apply({"params": params}, x)
+            return (y ** 2).sum(), y
+        return fn
+
+    (ls, ys), gs = jax.jit(jax.value_and_grad(
+        loss(enc_seq), has_aux=True))(variables["params"], x)
+    (lc, yc), gc = jax.jit(jax.value_and_grad(
+        loss(enc_cc), has_aux=True))(cc_params, x)
+    np.testing.assert_allclose(np.asarray(yc), np.asarray(ys),
+                               rtol=2e-4, atol=2e-4)
+    assert np.isclose(float(lc), float(ls), rtol=1e-4)
+    inv = np.argsort(order)
+    gc_net = _permute_stack(gc, inv)
+    for a, b in zip(jax.tree_util.tree_leaves(gs),
+                    jax.tree_util.tree_leaves(gc_net)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-3, atol=3e-4)
+
+
 def test_circular_pipeline_with_tensor_parallel():
     """Circular x Megatron: dp=2 x pp=2 x tp=2 with v=2 chunks per stage
     still matches the sequential encoder (logits)."""
