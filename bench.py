@@ -176,7 +176,7 @@ def _synth_imagenet_files(n_images: int = 256) -> str:
     content model) cached in /tmp — enough images to measure steady-state
     decode throughput; the iterator loops epochs so count doesn't matter."""
     d = os.path.join(tempfile.gettempdir(), "drt_bench_imagenet")
-    marker = os.path.join(d, "train-00003-of-00004")
+    marker = os.path.join(d, "validation-00001-of-00002")
     if not os.path.exists(marker):
         sys.path.insert(0, os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "tools"))
@@ -184,6 +184,8 @@ def _synth_imagenet_files(n_images: int = 256) -> str:
         os.makedirs(d, exist_ok=True)
         write_split(d, "train", 4, 4, num_classes=16,
                     per_class=max(1, n_images // 16), seed=0)
+        write_split(d, "validation", 2, 2, num_classes=16,
+                    per_class=max(1, n_images // 32), seed=1)
     return d
 
 
@@ -223,6 +225,42 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     except Exception:
         pass
     out["host_cores"] = ncpu
+
+    # (a2) full validation pass (VERDICT r3 #6): the eval path now runs
+    # the parallel decode pool + uint8 ship + device standardize.
+    # Decomposed like the train rows: the HOST side (decode to uint8
+    # crops — what a TPU-VM deployment is bounded by) and the e2e pass,
+    # which on THIS box is bounded by the tunnel's MB/s device link
+    # (cifar.device_put_MBps), not the framework.
+    try:
+        cfg = get_preset("imagenet_resnet50")
+        cfg.data.data_dir = d
+        cfg.data.num_parallel_calls = max(4, ncpu)
+        cfg.data.use_native_loader = True
+        cfg.mesh.data = len(jax.devices())
+        ev_host = create_input_iterator(cfg, mode="eval")
+        t0 = time.perf_counter()
+        n_host = sum(int(b.get("mask", np.ones(len(b["labels"]))).sum())
+                     for b in ev_host)
+        host_rate = n_host / (time.perf_counter() - t0)
+        trainer = Trainer(cfg)
+        trainer.init_state()
+        ev_iter = create_input_iterator(cfg, mode="eval")
+        trainer.evaluate(ev_iter, num_batches=1)  # compile the eval step
+        ev_iter = create_input_iterator(cfg, mode="eval")
+        t0 = time.perf_counter()
+        res = trainer.evaluate(ev_iter, num_batches=10 ** 9)  # to exhaustion
+        dt = time.perf_counter() - t0
+        n_ev = res["count"]
+        out["eval_pass"] = {
+            "images": n_ev,
+            "host_decode_images_per_sec": round(host_rate, 1),
+            "e2e_images_per_sec": round(n_ev / dt, 1),
+            "full_50k_pass_minutes_at_host_rate": round(
+                50000 / max(host_rate, 1e-9) / 60, 2),
+        }
+    except Exception as e:
+        out["eval_pass"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     if budget_left() < 60:
         out["skipped_e2e"] = "over bench budget"

@@ -12,9 +12,14 @@ def device_augment_enabled(cfg, mode: str = "train") -> bool:
     cifar*: the device does crop/flip/standardize (ops/augment.py).
     imagenet: the device does the VGG standardize only (the geometric ops
     are host-side, tied to per-image source sizes); the iterator then ships
-    uint8 crops — 4× smaller transfers, no host float pass."""
-    if mode != "train" or cfg.data.dataset not in (
-            "cifar10", "cifar100", "imagenet"):
+    uint8 crops — 4× smaller transfers, no host float pass. Round 4: the
+    imagenet EVAL path gets the same treatment (the standardize is
+    deterministic, so the only question is where the float pass runs;
+    make_eval_step applies it on device) — cifar eval stays host-side
+    (its standardize is per-image moments, fused into the host parse)."""
+    if cfg.data.dataset not in ("cifar10", "cifar100", "imagenet"):
+        return False
+    if mode != "train" and cfg.data.dataset != "imagenet":
         return False
     setting = cfg.data.device_augment
     if setting == "on":
@@ -53,5 +58,6 @@ def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
                                  prefetch_batches=d.prefetch_batches,
                                  use_native=d.use_native_loader,
                                  device_standardize=device_augment_enabled(
-                                     cfg, mode))
+                                     cfg, mode),
+                                 decode_processes=d.decode_processes)
     raise ValueError(f"unknown dataset {d.dataset!r}")

@@ -195,14 +195,22 @@ def make_train_step(schedule: Callable, weight_decay: float,
     return accum_step
 
 
-def make_eval_step():
+def make_eval_step(prep_fn: Optional[Callable] = None):
     """eval_step(state, batch) -> {correct, count, loss_sum} (summable over
     batches — the reference's numpy precision accumulation,
-    resnet_cifar_eval.py:111-122, done on-device instead)."""
+    resnet_cifar_eval.py:111-122, done on-device instead).
+
+    ``prep_fn(images) -> images`` runs device-side input prep (the
+    deterministic VGG standardize when the imagenet iterator ships raw
+    uint8 crops — data/__init__.device_augment_enabled decides, both
+    sides consult it)."""
 
     def eval_step(state: TrainState, batch):
         variables = {"params": state.params, "batch_stats": state.batch_stats}
-        logits = state.apply_fn(variables, batch["images"], train=False)
+        images = batch["images"]
+        if prep_fn is not None:
+            images = prep_fn(images)
+        logits = state.apply_fn(variables, images, train=False)
         labels = batch["labels"]
         # optional "mask" marks padding in the final partial batch
         mask = batch.get("mask")
@@ -301,7 +309,12 @@ class Trainer:
         self._aug_fn = aug_fn
         self._cfg_aug_fn = aug_fn  # the config-resolved choice, for detach
         self._train_step = self._build_train_step(aug_fn)
-        self._eval_step = make_eval_step()
+        eval_prep = None
+        if cfg.data.dataset == "imagenet" and \
+                device_augment_enabled(cfg, "eval"):
+            from ..ops.augment import vgg_standardize
+            eval_prep = vgg_standardize
+        self._eval_step = make_eval_step(eval_prep)
         self._jitted_train = None
         self._jitted_multi = None
         self._jitted_eval = None

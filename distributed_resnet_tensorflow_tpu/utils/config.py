@@ -86,6 +86,10 @@ class DataConfig:
     prefetch_batches: int = 2         # reference prefetches 2*bs samples (resnet_cifar_main.py:232)
     num_parallel_calls: int = 8
     use_native_loader: bool = False   # C++ threaded loader (native/)
+    # >0: decode in worker PROCESSES instead of threads (imagenet) — full
+    # GIL independence at the price of queue pickling; the measured
+    # thread-vs-process scaling story is docs/input_scaling_r4.json
+    decode_processes: int = 0
     # train-time device-side input work (ops/augment.py), auto = on iff TPU.
     # cifar*: crop/flip/standardize inside the jitted step; imagenet: the
     # VGG standardize only (iterator then ships raw uint8 crops) — see
@@ -192,7 +196,15 @@ class CheckpointConfig:
 class EvalConfig:
     """Standalone polling evaluator (reference resnet_cifar_eval.py:85-141)."""
 
-    eval_batch_count: int = 50        # reference eval_batch_count flag (=50)
+    # reference eval_batch_count flag (=50, i.e. 50×100 CIFAR images).
+    # For the full ImageNet validation set size it to cover all 50,000
+    # images: ceil(50000 / data.eval_batch_size) (=500 at the default 100);
+    # the iterator masks the final partial batch, and a larger count just
+    # stops at stream exhaustion, so overshooting is safe single-process.
+    # The measured full-pass wall time rides in bench.py's
+    # imagenet_input.eval_pass key (native decode + uint8 ship + device
+    # standardize, docs r4).
+    eval_batch_count: int = 50
     eval_once: bool = False
     poll_interval_secs: float = 60.0  # reference sleeps 60s between polls
     eval_dir: str = ""

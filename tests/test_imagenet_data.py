@@ -205,8 +205,70 @@ def test_imagenet_iterator_uint8_device_standardize(tmp_path):
     from distributed_resnet_tensorflow_tpu.data.preprocessing import RGB_MEANS
     want = b["images"].astype(np.float32) / 255.0 - RGB_MEANS
     np.testing.assert_allclose(out, want, atol=1e-6)
-    # eval stays float (no device hook on the eval step)
+    # r4: eval ships uint8 too (make_eval_step applies the deterministic
+    # standardize on device — see test_eval_uint8_metrics_match below)
     _write_fake_imagenet(tmp_path, mode="validation")
     it_ev = imagenet_iterator(d, batch_size=4, mode="eval", image_size=32,
                               device_standardize=True)
-    assert next(it_ev)["images"].dtype == np.float32
+    assert next(it_ev)["images"].dtype == np.uint8
+
+
+def test_eval_uint8_metrics_match(tmp_path):
+    """A full eval pass over the uint8 (device-standardize) iterator with
+    the prep-hooked eval step == the host-float pass bit-for-bit on
+    correctness counts (same images, same masked tail)."""
+    from distributed_resnet_tensorflow_tpu.train.loop import make_eval_step
+    from distributed_resnet_tensorflow_tpu.train.state import (
+        create_train_state)
+    from distributed_resnet_tensorflow_tpu.models import CifarResNetV2
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from distributed_resnet_tensorflow_tpu.ops.augment import vgg_standardize
+
+    d, total = _write_fake_imagenet(tmp_path, mode="validation")
+    model = CifarResNetV2(resnet_size=8, num_classes=8, dtype=jnp.float32)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               optax.sgd(0.1), (1, 32, 32, 3))
+
+    def run(device_standardize):
+        it = imagenet_iterator(d, batch_size=5, mode="eval", image_size=32,
+                               device_standardize=device_standardize)
+        step = make_eval_step(vgg_standardize if device_standardize else None)
+        totals = None
+        for b in it:
+            out = step(state, {k: jnp.asarray(v) for k, v in b.items()})
+            totals = out if totals is None else jax.tree_util.tree_map(
+                jnp.add, totals, out)
+        return totals
+
+    host = run(False)
+    dev = run(True)
+    assert int(host["count"]) == total and int(dev["count"]) == total
+    assert int(host["correct"]) == int(dev["correct"])
+    np.testing.assert_allclose(float(host["loss_sum"]),
+                               float(dev["loss_sum"]), rtol=1e-5)
+
+
+def test_decode_processes_pool(tmp_path):
+    """decode_processes > 0: the fork-based worker pool yields the same
+    record multiset as the thread pool (eval mode — deterministic set),
+    exhausts cleanly, and propagates the masked tail."""
+    d, total = _write_fake_imagenet(tmp_path, mode="validation")
+    it = imagenet_iterator(d, batch_size=5, mode="eval", image_size=32,
+                           decode_processes=2)
+    labels = []
+    got_mask = False
+    for b in it:
+        mask = b.get("mask", np.ones(len(b["labels"])))
+        got_mask = got_mask or "mask" in b
+        labels.extend(int(l) for l, m in zip(b["labels"], mask) if m)
+    assert len(labels) == total
+    assert got_mask
+    it2 = imagenet_iterator(d, batch_size=5, mode="eval", image_size=32,
+                            num_decode_threads=2)
+    ref = []
+    for b in it2:
+        mask = b.get("mask", np.ones(len(b["labels"])))
+        ref.extend(int(l) for l, m in zip(b["labels"], mask) if m)
+    assert sorted(labels) == sorted(ref)

@@ -1,0 +1,170 @@
+"""Input-pipeline scaling harness (VERDICT r3 #5).
+
+Measures, on ImageNet-format JPEG TFRecord shards:
+  * the single-stream feeder ceiling (TFRecord read + CRC + Example parse,
+    no decode) — python reader vs the native C++ prefetcher;
+  * decoded img/s at 1/2/4 decode workers, thread pool vs process pool
+    (``decode_processes``), PIL vs the native fused transform;
+  * the multi-process sharded aggregate (P independent iterator processes,
+    each reading files[p::P] — the multi-host deployment shape).
+
+On this 1-core box the expected curve is FLAT (one core executes every
+worker); the point of the artifact is (a) the per-worker overhead — a
+drop at 2/4 workers would expose queue serialization the round-3 README
+extrapolation ("~10 cores cover the chip") silently assumed away — and
+(b) the measured feeder ceiling, which bounds any thread count.
+
+    python tools/input_scaling.py   # writes docs/input_scaling_r4.json
+"""
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+OUT = os.path.join(REPO, "docs", "input_scaling_r4.json")
+
+
+def synth_dir(n_images=512):
+    import tempfile
+    from make_synth_imagenet import write_split
+    d = os.path.join(tempfile.gettempdir(), "drt_scaling_imagenet")
+    if not os.path.exists(os.path.join(d, "train-00007-of-00008")):
+        os.makedirs(d, exist_ok=True)
+        write_split(d, "train", 8, 8, num_classes=16,
+                    per_class=n_images // 16, seed=0)
+    return d
+
+
+def feeder_rate(d, use_native, n=400):
+    """Records/s of the raw (read + CRC + parse) stream, decode excluded."""
+    from distributed_resnet_tensorflow_tpu.data.imagenet import (
+        dataset_filenames, _example_to_sample)
+    from distributed_resnet_tensorflow_tpu.data.tfrecord import (
+        parse_example, read_tfrecords)
+    files = dataset_filenames(d, "train")
+
+    def stream():
+        if use_native:
+            from distributed_resnet_tensorflow_tpu.data.native_loader import (
+                NativePrefetcher)
+            while True:
+                pf = NativePrefetcher(files, num_threads=4)
+                yield from pf
+                pf.close()
+        else:
+            while True:
+                for f in files:
+                    yield from read_tfrecords(f)
+
+    it = stream()
+    for _ in range(50):  # warm
+        next(it)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        _example_to_sample(parse_example(next(it)))
+    return round(n / (time.perf_counter() - t0), 1)
+
+
+def decode_rate(d, workers, processes, use_native, batches=8, bs=64):
+    from distributed_resnet_tensorflow_tpu.data.imagenet import (
+        imagenet_iterator)
+    it = imagenet_iterator(
+        d, bs, "train", image_size=224, shuffle_buffer=64,
+        num_decode_threads=0 if processes else workers,
+        decode_processes=workers if processes else 0,
+        use_native=use_native, device_standardize=True)
+    next(it)  # warm the pool
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        next(it)
+    return round(bs * batches / (time.perf_counter() - t0), 1)
+
+
+def _shard_worker(d, p, num_shards, bs, batches, q):
+    from distributed_resnet_tensorflow_tpu.data.imagenet import (
+        imagenet_iterator)
+    it = imagenet_iterator(d, bs, "train", image_size=224, shuffle_buffer=64,
+                           shard_index=p, num_shards=num_shards,
+                           num_decode_threads=2, device_standardize=True)
+    next(it)
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        next(it)
+    q.put(bs * batches / (time.perf_counter() - t0))
+
+
+def sharded_aggregate(d, num_shards, bs=32, batches=6):
+    """P independent full-pipeline processes over disjoint file shards —
+    the multi-host shape (one iterator per host feeding its own chip)."""
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_shard_worker,
+                         args=(d, p, num_shards, bs, batches, q))
+             for p in range(num_shards)]
+    for p in procs:
+        p.start()
+    rates = [q.get() for _ in procs]
+    for p in procs:
+        p.join()
+    return round(sum(rates), 1)
+
+
+def _one_point(d, label, workers):
+    """Executed in a FRESH subprocess per grid point: forking worker
+    processes from an interpreter that already ran thread-pool iterators
+    (live daemon feeder/decoder threads) can inherit a held lock and
+    deadlock the child — each measurement gets a thread-free parent."""
+    procs = label.startswith("processes")
+    native = label.endswith("native")
+    print(decode_rate(d, workers, procs, native))
+
+
+def main():
+    import subprocess
+    d = synth_dir()
+    from distributed_resnet_tensorflow_tpu.data.native_loader import (
+        native_available, native_jpeg_available)
+    out = {"host_cores": os.cpu_count(),
+           "native_reader": bool(native_available()),
+           "native_jpeg": bool(native_jpeg_available())}
+    out["feeder_records_per_sec"] = {
+        "python_reader": feeder_rate(d, False),
+    }
+    if out["native_reader"]:
+        out["feeder_records_per_sec"]["native_prefetcher"] = feeder_rate(
+            d, True)
+    for label, native in (("threads_pil", False),
+                          ("threads_native", True),
+                          ("processes_pil", False),
+                          ("processes_native", True)):
+        if native and not out["native_jpeg"]:
+            continue
+        row = {}
+        for w in (1, 2, 4):
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--point", label, str(w)],
+                capture_output=True, text=True, timeout=300)
+            row[w] = float(r.stdout.strip().splitlines()[-1]) \
+                if r.returncode == 0 and r.stdout.strip() else None
+        out[label] = row
+        print(label, row, flush=True)
+    out["sharded_aggregate_img_per_sec"] = {
+        p: sharded_aggregate(d, p) for p in (1, 2)}
+    print("sharded", out["sharded_aggregate_img_per_sec"], flush=True)
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--point":
+        _one_point(synth_dir(), sys.argv[2], int(sys.argv[3]))
+    else:
+        main()
