@@ -69,7 +69,7 @@ def _layer_norm(x, scale, bias):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _block_apply(p, x, num_heads, dtype, tp_axis=None):
+def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense"):
     """One encoder block from a stacked-param slice ``p`` — the explicit-math
     twin of transformer.EncoderBlock (kept in lockstep; exact-parity test:
     tests/test_pipeline.py).
@@ -81,13 +81,27 @@ def _block_apply(p, x, num_heads, dtype, tp_axis=None):
     contractions (attention out-proj, MLP down-proj) then produce partial
     sums that one ``lax.psum`` each completes — 2 collectives per block,
     exactly the Megatron count. Replicated tensors (x, LN params, mlp_b2)
-    stay replicated across ``tp_axis``."""
+    stay replicated across ``tp_axis``.
+
+    ``attn_impl``: "dense" (XLA reference) or the fused Pallas flash kernel
+    ("flash" / "flash_interpret" for CPU tests) — long-context attention
+    inside pipeline stages (round 4; the pallas_call runs fine under the
+    pipeline shard_map, and the kernel's custom vjp rides the transposed
+    scan schedule like any other block op)."""
     b, t, d = x.shape
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     qkv = jnp.einsum("btd,dchk->btchk", h, p["qkv_kernel"].astype(dtype))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    from ..ops.attention import attention
-    o = attention(q, k, v)  # local heads only under tp
+    if attn_impl in ("flash", "flash_interpret"):
+        from ..ops.pallas import flash_attention
+        o = flash_attention(q, k, v, False, attn_impl == "flash_interpret")
+    elif attn_impl == "dense":
+        from ..ops.attention import attention
+        o = attention(q, k, v)  # local heads only under tp
+    else:
+        raise ValueError(
+            f"pipelined blocks support dense/flash attention, "
+            f"got {attn_impl!r}")
     o = jnp.einsum("bthk,hkd->btd", o, p["proj_kernel"].astype(dtype))
     if tp_axis is not None:
         o = lax.psum(o, tp_axis)
@@ -115,6 +129,7 @@ class PipelinedEncoder(nn.Module):
     microbatches: int = 0  # 0 → 2 × pipeline stages
     remat: bool = False    # jax.checkpoint each block (GPipe's usual pairing)
     interleave: int = 1    # v>1 → circular schedule, v chunks per stage
+    attention_impl: str = "dense"  # dense | flash | flash_interpret
 
     def _params(self, d):
         hd = d // self.num_heads
@@ -163,12 +178,13 @@ class PipelinedEncoder(nn.Module):
         block_fn = _block_apply
         if self.remat:
             block_fn = jax.checkpoint(
-                _block_apply, static_argnums=(2, 3, 4))
+                _block_apply, static_argnums=(2, 3, 4, 5))
 
         def run_layers(p, h, tp_ax=None):
             return lax.scan(
                 lambda hh, pp: (block_fn(pp, hh, self.num_heads,
-                                         self.dtype, tp_ax), None),
+                                         self.dtype, tp_ax,
+                                         self.attention_impl), None),
                 h, p)[0]
 
         v = max(1, self.interleave)

@@ -38,27 +38,34 @@ def _constrain(x: jax.Array, mesh, spec: "P") -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def flash_or_dense(t: int) -> str:
+    """The ONE auto rule for flash-vs-dense (no seq axis involved): the
+    Pallas kernel on TPU past its measured crossover vs dense —
+    docs/flash_tune_r3.json: parity at 1k tokens, 1.1× at 2k, 1.4× at 4k,
+    10× at 8k. Shared by the per-block path (_apply_attention) and the
+    pipelined path (stage blocks see the full t per microbatch)."""
+    return "flash" if (jax.default_backend() == "tpu"
+                       and t >= 2048) else "dense"
+
+
 def _apply_attention(q, k, v, impl: str, mesh=None):
     if impl == "auto":
         # resolved HERE, where the true sequence length is known at trace
-        # time: ring when a seq mesh axis exists; the Pallas flash kernel on
-        # TPU past its measured crossover vs dense — docs/flash_tune_r3.json:
-        # parity at 1k tokens, 1.1× at 2k, 1.4× at 4k, 10× at 8k — else dense
+        # time: ring when a seq mesh axis exists; otherwise the shared
+        # flash_or_dense crossover rule
         if mesh is not None and mesh.shape.get("seq", 1) > 1:
             impl = "ring"
-        elif jax.default_backend() == "tpu" and q.shape[1] >= 2048:
-            impl = "flash"
         else:
-            impl = "dense"
+            impl = flash_or_dense(q.shape[1])
     if impl == "dense":
         from ..ops.attention import attention
         return attention(q, k, v)
     if impl == "blockwise":
         from ..ops.attention import blockwise_attention
         return blockwise_attention(q, k, v)
-    if impl == "flash":
+    if impl in ("flash", "flash_interpret"):
         from ..ops.pallas import flash_attention
-        return flash_attention(q, k, v)
+        return flash_attention(q, k, v, False, impl == "flash_interpret")
     if impl == "ring":
         from ..ops.attention import ring_attention_sharded
         if mesh is None or mesh.shape.get("seq", 1) <= 1:
@@ -188,13 +195,19 @@ class VisionTransformer(nn.Module):
         if pipeline > 1:
             # GPipe microbatch pipeline over stacked-parameter stages
             # (models/pipeline.py); parameterization differs from the
-            # per-block modules (pack_encoder_params converts)
-            # dense only: 'auto' under pipeline MEANS dense (the flash
-            # kernel is not plumbed through the stacked-stage block); other
-            # impls are rejected rather than silently substituted
-            if self.attention_impl not in ("auto", "dense"):
+            # per-block modules (pack_encoder_params converts).
+            # Attention inside a stage: dense, or the fused Pallas flash
+            # kernel (round 4) — 'auto' applies the same trace-time rule as
+            # the unpipelined path (flash on TPU past the measured
+            # crossover, docs/flash_tune_r3.json; the pipeline's
+            # per-microbatch token count is the full t). ring/blockwise
+            # stay rejected (no seq axis inside a stage).
+            impl = self.attention_impl
+            if impl == "auto":
+                impl = flash_or_dense(t)
+            if impl not in ("dense", "flash", "flash_interpret"):
                 raise ValueError(
-                    "pipeline parallelism supports dense attention only "
+                    "pipeline parallelism supports dense/flash attention "
                     f"(got attention_impl={self.attention_impl!r})")
             if self.num_experts > 0:
                 raise ValueError(
@@ -206,6 +219,7 @@ class VisionTransformer(nn.Module):
                                  microbatches=self.pipeline_microbatches,
                                  interleave=self.pipeline_interleave,
                                  remat=self.remat,
+                                 attention_impl=impl,
                                  name="encoder")(x)
         else:
             block = EncoderBlock

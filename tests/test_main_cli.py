@@ -2,6 +2,8 @@
 (SURVEY.md §1 L3)."""
 import os
 
+import pytest
+
 import numpy as np
 
 from distributed_resnet_tensorflow_tpu import main as main_mod
@@ -68,3 +70,22 @@ def test_main_eval_once_mode(tmp_path):
     recs = [json.loads(l) for l in open(path) if l.strip()]
     assert recs and "eval/precision" in recs[-1]
     assert "eval/best_precision" in recs[-1]
+
+
+@pytest.mark.heavy
+def test_replay_reference_smoke(tmp_path, monkeypatch):
+    """tools/replay_reference.py --smoke runs the full recipe machinery
+    (preset -> train -> checkpoint -> full-set eval -> report) end to end
+    on synthetic stand-in data — the proof the one-command real-data
+    replication path works before real data is reachable."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import replay_reference
+    report = replay_reference.main(
+        ["--dataset", "cifar10", "--smoke",
+         "--log_root", str(tmp_path / "replay")])
+    assert report["dataset"] == "cifar10"
+    assert report["eval_images"] == 200  # the FULL synthetic test split
+    assert 0.0 <= report["top1"] <= 1.0
+    assert os.path.exists(str(tmp_path / "replay" / "replay_report.md"))

@@ -43,7 +43,7 @@ def class_images(cls: int, n: int, rng: np.random.RandomState,
     theta = np.arctan2(yy - 15.5, xx - 15.5)
     if num_classes <= 10:
         freq = 0.10 + 0.018 * (cls % 5)
-        harmonic = 1.0
+        harmonic = np.ones_like(theta)
         w = np.array([[1.0, 0.5, -0.2], [0.5, 1.0, 0.2]][cls // 5])
     else:
         freq = 0.08 + 0.016 * (cls % 10)                       # 10 frequencies
