@@ -101,6 +101,63 @@ def _route_assign(flat_probs: jax.Array, num_experts: int, capacity: int,
     return assigned
 
 
+def switch_aux_loss(flat_probs: jax.Array) -> jax.Array:
+    """Switch load-balancing loss E·Σ_e fraction_e·mean_prob_e over one
+    token group (first-choice fractions)."""
+    e = flat_probs.shape[-1]
+    onehot = jax.nn.one_hot(jnp.argmax(flat_probs, -1), e,
+                            dtype=jnp.float32)
+    return e * jnp.sum(onehot.mean(axis=0) * flat_probs.mean(axis=0))
+
+
+def gather_slot_table(assigned, n: int, capacity: int, e_local: int,
+                      e_lo=0):
+    """The O(N + E·C) dispatch's slot table for the ``e_local`` experts
+    starting at (possibly traced, per-device) index ``e_lo``: kept token n
+    occupies slot (idx - e_lo)·C + pos; everything else (drops, other
+    devices' experts) writes out of bounds (mode="drop"). Empty slots keep
+    the sentinel ``n`` so a gather from an (n+1)-row padded table reads
+    the zero row. Shared by the unsharded gather dispatch, the a2a
+    shard_map body, and the pipelined MoE block (pipeline.py _moe_mlp)."""
+    nslots = e_local * capacity
+    sel = jnp.full((nslots,), n, jnp.int32)
+    for idx_k, _gate, pos_k, keep_k in assigned:
+        idx_l = idx_k - e_lo
+        ok = jnp.logical_and(keep_k, jnp.logical_and(idx_l >= 0,
+                                                     idx_l < e_local))
+        slot = jnp.where(ok, idx_l * capacity + pos_k, nslots)
+        sel = sel.at[slot].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    return sel
+
+
+def combine_from_slots(assigned, eout: jax.Array, n: int, capacity: int,
+                       dtype, e_local: int, e_lo=0) -> jax.Array:
+    """Inverse of gather_slot_table: per-token gate-weighted gather of the
+    expert outputs ``eout`` ((e_local·C), D) back to (n, D). Gates are
+    already zeroed for dropped assignments; out-of-range experts (other
+    devices') are masked so a psum over the expert axis completes the
+    combine."""
+    nslots = eout.shape[0]
+    out = jnp.zeros((n, eout.shape[1]), dtype)
+    for idx_k, gate_k, pos_k, _keep in assigned:
+        idx_l = idx_k - e_lo
+        ok = jnp.logical_and(idx_l >= 0, idx_l < e_local)
+        slot = jnp.clip(idx_l * capacity + pos_k, 0, nslots - 1)
+        out = out + (gate_k * ok).astype(dtype)[:, None] \
+            * jnp.take(eout, slot, axis=0)
+    return out
+
+
+def expert_ffn(ein: jax.Array, w1, b1, w2, b2, dtype) -> jax.Array:
+    """(E, C, D) expert inputs → (E, C, D) outputs (E may be a local block
+    of the stacked expert params)."""
+    h = jnp.einsum("ecd,edf->ecf", ein, w1.astype(dtype)) \
+        + b1[:, None, :].astype(dtype)
+    h = nn.gelu(h)
+    return jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype)) \
+        + b2[:, None, :].astype(dtype)
+
+
 class SwitchMlp(nn.Module):
     """Drop-in replacement for the EncoderBlock MLP: LN'd input in,
     residual-branch output out. Shapes: (B, T, D) → (B, T, D)."""
@@ -148,16 +205,11 @@ class SwitchMlp(nn.Module):
             x.astype(jnp.float32))                       # (B, T, E)
         probs = jax.nn.softmax(logits, axis=-1)
         flat_probs = probs.reshape(n_tokens, e)
-        expert_idx = jnp.argmax(flat_probs, axis=-1)     # (N,) first choice
-        gate1 = jnp.max(flat_probs, axis=-1)             # (N,)
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
 
         # Switch aux loss: E * Σ_e (fraction of tokens routed to e) · (mean
         # router prob of e) — pushes the router toward uniform utilization
         # (first-choice fractions in both routing modes, the Switch form)
-        fraction = onehot.mean(axis=0)
-        mean_prob = flat_probs.mean(axis=0)
-        self.sow("losses", "moe_aux", e * jnp.sum(fraction * mean_prob))
+        self.sow("losses", "moe_aux", switch_aux_loss(flat_probs))
 
         mode = self.dispatch
         sharded_e = (self.mesh is not None
@@ -223,14 +275,7 @@ class SwitchMlp(nn.Module):
         return out.reshape(b, t, d)
 
     def _expert_mlp(self, ein, params):
-        """(E, C, D) expert inputs → (E, C, D) outputs (E may be a local
-        block of the stacked expert params)."""
-        w1, b1, w2, b2 = params
-        h = jnp.einsum("ecd,edf->ecf", ein, w1.astype(self.dtype)) \
-            + b1[:, None, :].astype(self.dtype)
-        h = nn.gelu(h)
-        return jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype)) \
-            + b2[:, None, :].astype(self.dtype)
+        return expert_ffn(ein, *params, self.dtype)
 
     def _gather_dispatch(self, flat_x, flat_probs, capacity, params):
         """O(N + E·C) dispatch for ONE capacity group: scatter the kept
@@ -241,24 +286,14 @@ class SwitchMlp(nn.Module):
         n, d = flat_x.shape
         e = self.num_experts
         assigned = _route_assign(flat_probs, e, capacity, self.top_k)
-        nslots = e * capacity
-        sel = jnp.full((nslots,), n, jnp.int32)
-        for idx_k, _gate, pos_k, keep_k in assigned:
-            slot = idx_k * capacity + pos_k
-            slot = jnp.where(keep_k, slot, nslots)
-            sel = sel.at[slot].set(jnp.arange(n, dtype=jnp.int32),
-                                   mode="drop")
+        sel = gather_slot_table(assigned, n, capacity, e)
         padded = jnp.concatenate(
             [flat_x.astype(self.dtype),
              jnp.zeros((1, d), self.dtype)], axis=0)
         ein = jnp.take(padded, sel, axis=0).reshape(e, capacity, d)
-        eout = self._expert_mlp(ein, params).reshape(nslots, d)
-        out = jnp.zeros((n, d), self.dtype)
-        for idx_k, gate_k, pos_k, _keep in assigned:
-            slot = jnp.clip(idx_k * capacity + pos_k, 0, nslots - 1)
-            out = out + gate_k[:, None].astype(self.dtype) \
-                * jnp.take(eout, slot, axis=0)
-        return out
+        eout = self._expert_mlp(ein, params).reshape(e * capacity, d)
+        return combine_from_slots(assigned, eout, n, capacity,
+                                  self.dtype, e)
 
     def _a2a_shards(self) -> int:
         return math.prod(self.mesh.shape.get(a, 1)
@@ -291,12 +326,7 @@ class SwitchMlp(nn.Module):
             # xs (n_sub, d) this device's token sub-shard; ps (n_sub, e);
             # w*l the local expert block (e_loc, ...)
             assigned = _route_assign(ps, e, cap, top_k)
-            nslots = e * cap
-            sel = jnp.full((nslots,), n_sub, jnp.int32)
-            for idx_k, _g, pos_k, keep_k in assigned:
-                slot = jnp.where(keep_k, idx_k * cap + pos_k, nslots)
-                sel = sel.at[slot].set(
-                    jnp.arange(n_sub, dtype=jnp.int32), mode="drop")
+            sel = gather_slot_table(assigned, n_sub, cap, e)
             padded = jnp.concatenate(
                 [xs.astype(dtype), jnp.zeros((1, d), dtype)], axis=0)
             # (ep, e_loc, cap, d): row j = my tokens for expert chunk j
@@ -308,13 +338,8 @@ class SwitchMlp(nn.Module):
             eo = eo.reshape(e_loc, ep, cap, d).transpose(1, 0, 2, 3)
             # send peer p's token outputs home; receive mine from each chunk
             eo = jax.lax.all_to_all(eo, "expert", 0, 0)
-            eout = eo.reshape(nslots, d)
-            res = jnp.zeros((n_sub, d), dtype)
-            for idx_k, gate_k, pos_k, _keep in assigned:
-                slot = jnp.clip(idx_k * cap + pos_k, 0, nslots - 1)
-                res = res + gate_k[:, None].astype(dtype) \
-                    * jnp.take(eout, slot, axis=0)
-            return res
+            eout = eo.reshape(e * cap, d)
+            return combine_from_slots(assigned, eout, n_sub, cap, dtype, e)
 
         tok = P(("data", "fsdp", "expert"), None)
         sharded = shard_map_compat(

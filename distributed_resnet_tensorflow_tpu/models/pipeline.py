@@ -69,7 +69,8 @@ def _layer_norm(x, scale, bias):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense"):
+def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense",
+                 moe=None):
     """One encoder block from a stacked-param slice ``p`` — the explicit-math
     twin of transformer.EncoderBlock (kept in lockstep; exact-parity test:
     tests/test_pipeline.py).
@@ -87,7 +88,13 @@ def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense"):
     ("flash" / "flash_interpret" for CPU tests) — long-context attention
     inside pipeline stages (round 4; the pallas_call runs fine under the
     pipeline shard_map, and the kernel's custom vjp rides the transposed
-    scan schedule like any other block op)."""
+    scan schedule like any other block op).
+
+    When ``p`` carries MoE leaves (moe_w1/...), the MLP is a Switch
+    mixture (pp×ep, see _moe_mlp); ``moe`` is the static
+    (top_k, capacity_factor, ep_axis) triple. Returns (x, aux) — aux is
+    the Switch load-balancing loss for this block (0.0 for the dense
+    MLP)."""
     b, t, d = x.shape
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     qkv = jnp.einsum("btd,dchk->btchk", h, p["qkv_kernel"].astype(dtype))
@@ -107,6 +114,10 @@ def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense"):
         o = lax.psum(o, tp_axis)
     x = x + o
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    if "moe_w1" in p:
+        top_k, cap_factor, ep_axis = moe or (1, 1.25, None)
+        h, aux = _moe_mlp(p, h, dtype, top_k, cap_factor, ep_axis)
+        return x + h, aux
     h = jnp.einsum("btd,df->btf", h, p["mlp_w1"].astype(dtype)) \
         + p["mlp_b1"].astype(dtype)
     h = nn.gelu(h)
@@ -114,7 +125,55 @@ def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense"):
     if tp_axis is not None:
         h = lax.psum(h, tp_axis)
     h = h + p["mlp_b2"].astype(dtype)
-    return x + h
+    return x + h, jnp.float32(0.0)
+
+
+def _moe_mlp(p, h, dtype, top_k=1, capacity_factor=1.25, ep_axis=None):
+    """Switch MoE MLP from stacked-slice params, expert-sharded over
+    ``ep_axis`` inside the pipeline shard_map (pp×ep, round 4).
+
+    Tokens arrive REPLICATED across the expert axis (the pipeline body's
+    x spec mentions batch axes only), so each device can gather its LOCAL
+    experts' token slots directly — the O(N + E_loc·C) slot-table dispatch
+    of models/moe.py, offset into the device's expert range — compute its
+    expert block, and contribute a partial combine; ONE ``lax.psum`` over
+    the expert axis completes the output. No one-hot tensors, no token
+    all-to-all (the replication the pipeline already maintains makes the
+    exchange free). Routing runs identically on every expert-peer
+    (replicated router params) so drop decisions are globally consistent;
+    the capacity group is the (data-shard, microbatch) token block.
+    Returns (out, aux) with the Switch load-balancing loss.
+
+    Routing/dispatch/combine/FFN math is the SHARED models/moe.py
+    machinery (_route_assign, gather_slot_table, combine_from_slots,
+    expert_ffn, switch_aux_loss) — the only pipeline-specific parts are
+    the per-device expert offset and the completing psum."""
+    import math
+    from .moe import (_route_assign, combine_from_slots, expert_ffn,
+                      gather_slot_table, switch_aux_loss)
+    b, t, d = h.shape
+    n = b * t
+    e_glob = p["router_kernel"].shape[-1]
+    e_loc = p["moe_w1"].shape[0]
+    my = lax.axis_index(ep_axis) if ep_axis is not None else 0
+    flat = h.reshape(n, d)
+    logits = flat.astype(jnp.float32) @ p["router_kernel"].astype(jnp.float32) \
+        + p["router_bias"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    cap = max(1, math.ceil(top_k * (n / e_glob) * capacity_factor))
+    assigned = _route_assign(probs, e_glob, cap, top_k)
+
+    sel = gather_slot_table(assigned, n, cap, e_loc, e_lo=my * e_loc)
+    padded = jnp.concatenate(
+        [flat.astype(dtype), jnp.zeros((1, d), dtype)], axis=0)
+    ein = jnp.take(padded, sel, axis=0).reshape(e_loc, cap, d)
+    eout = expert_ffn(ein, p["moe_w1"], p["moe_bias1"], p["moe_w2"],
+                      p["moe_bias2"], dtype).reshape(e_loc * cap, d)
+    out = combine_from_slots(assigned, eout, n, cap, dtype, e_loc,
+                             e_lo=my * e_loc)
+    if ep_axis is not None:
+        out = lax.psum(out, ep_axis)
+    return out.reshape(b, t, d), switch_aux_loss(probs)
 
 
 class PipelinedEncoder(nn.Module):
@@ -130,6 +189,9 @@ class PipelinedEncoder(nn.Module):
     remat: bool = False    # jax.checkpoint each block (GPipe's usual pairing)
     interleave: int = 1    # v>1 → circular schedule, v chunks per stage
     attention_impl: str = "dense"  # dense | flash | flash_interpret
+    num_experts: int = 0           # >0 → Switch MoE MLPs (pp×ep)
+    expert_capacity_factor: float = 1.25
+    moe_top_k: int = 1
 
     def _params(self, d):
         hd = d // self.num_heads
@@ -139,7 +201,7 @@ class PipelinedEncoder(nn.Module):
             return self.param(name, init, (self.depth,) + shape, jnp.float32)
         ones = lambda key, shape, dtype: jnp.ones(shape, dtype)   # noqa: E731
         zeros = nn.initializers.zeros
-        return {
+        p = {
             "ln1_scale": stacked("ln1_scale", (d,), ones),
             "ln1_bias": stacked("ln1_bias", (d,), zeros),
             "qkv_kernel": stacked(
@@ -152,17 +214,42 @@ class PipelinedEncoder(nn.Module):
                    out_axis=3, batch_axis=0)),
             "ln2_scale": stacked("ln2_scale", (d,), ones),
             "ln2_bias": stacked("ln2_bias", (d,), zeros),
-            "mlp_w1": stacked(
-                "mlp_w1", (d, f),
-                vs(1.0, "fan_in", "truncated_normal", in_axis=1, out_axis=2,
-                   batch_axis=0)),
-            "mlp_b1": stacked("mlp_b1", (f,), zeros),
-            "mlp_w2": stacked(
-                "mlp_w2", (f, d),
-                vs(1.0, "fan_in", "truncated_normal", in_axis=1, out_axis=2,
-                   batch_axis=0)),
-            "mlp_b2": stacked("mlp_b2", (d,), zeros),
         }
+        if self.num_experts > 0:
+            e = self.num_experts
+            # SwitchMlp's stacked-expert layout with a leading depth axis;
+            # "bias"-named like models/moe.py so optimizer masks skip them
+            p.update({
+                "router_kernel": stacked(
+                    "router_kernel", (d, e),
+                    vs(1.0, "fan_in", "truncated_normal",
+                       in_axis=1, out_axis=2, batch_axis=0)),
+                "router_bias": stacked("router_bias", (e,), zeros),
+                "moe_w1": stacked(
+                    "moe_w1", (e, d, f),
+                    vs(1.0, "fan_in", "truncated_normal", in_axis=2,
+                       out_axis=3, batch_axis=(0, 1))),
+                "moe_bias1": stacked("moe_bias1", (e, f), zeros),
+                "moe_w2": stacked(
+                    "moe_w2", (e, f, d),
+                    vs(1.0, "fan_in", "truncated_normal", in_axis=2,
+                       out_axis=3, batch_axis=(0, 1))),
+                "moe_bias2": stacked("moe_bias2", (e, d), zeros),
+            })
+        else:
+            p.update({
+                "mlp_w1": stacked(
+                    "mlp_w1", (d, f),
+                    vs(1.0, "fan_in", "truncated_normal", in_axis=1,
+                       out_axis=2, batch_axis=0)),
+                "mlp_b1": stacked("mlp_b1", (f,), zeros),
+                "mlp_w2": stacked(
+                    "mlp_w2", (f, d),
+                    vs(1.0, "fan_in", "truncated_normal", in_axis=1,
+                       out_axis=2, batch_axis=0)),
+                "mlp_b2": stacked("mlp_b2", (d,), zeros),
+            })
+        return p
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -178,14 +265,34 @@ class PipelinedEncoder(nn.Module):
         block_fn = _block_apply
         if self.remat:
             block_fn = jax.checkpoint(
-                _block_apply, static_argnums=(2, 3, 4, 5))
+                _block_apply, static_argnums=(2, 3, 4, 5, 6))
+        moe_static = None
+        if self.num_experts > 0:
+            ep = self.mesh.shape.get("expert", 1) \
+                if self.mesh is not None else 1
+            moe_static = (self.moe_top_k, self.expert_capacity_factor,
+                          "expert" if ep > 1 else None)
+            if self.num_experts % max(1, ep):
+                raise ValueError(
+                    f"num_experts {self.num_experts} not divisible by "
+                    f"expert axis {ep}")
 
-        def run_layers(p, h, tp_ax=None):
-            return lax.scan(
-                lambda hh, pp: (block_fn(pp, hh, self.num_heads,
-                                         self.dtype, tp_ax,
-                                         self.attention_impl), None),
-                h, p)[0]
+        def run_layers(p, h, tp_ax=None, moe_over=None):
+            """(h, aux_sum) over this param stack's layers. ``moe_over``
+            overrides the static moe triple — callers OUTSIDE a shard_map
+            (init fallback) must clear the expert axis name, which is only
+            bound inside the mapped body."""
+            mo = moe_over if moe_over is not None else moe_static
+            def step(hh, pp):
+                hh, aux = block_fn(pp, hh, self.num_heads, self.dtype,
+                                   tp_ax, self.attention_impl, mo)
+                return hh, aux
+            h, auxs = lax.scan(step, h, p)
+            return h, jnp.sum(auxs)
+
+        def moe_unmapped():
+            return (moe_static[0], moe_static[1], None) \
+                if moe_static is not None else None
 
         v = max(1, self.interleave)
         if pstages > 1 and nblocks % (pstages * v):
@@ -215,15 +322,26 @@ class PipelinedEncoder(nn.Module):
         else:
             n_batch_shards = 1
         local_b = b // max(1, n_batch_shards)
+
+        def finish(y, aux):
+            if self.num_experts > 0 and not self.is_initializing():
+                self.sow("losses", "moe_aux", aux)
+            return y
+
         if pstages <= 1:
-            return run_layers(params, x)
+            # sequential path (mesh-less, or pipeline axis collapsed):
+            # plain layer scan. The product only reaches PipelinedEncoder
+            # with pipeline > 1 (VisionTransformer routes unpipelined MoE
+            # through SwitchMlp), so no expert axis handling lives here.
+            y, aux = run_layers(params, x, moe_over=moe_unmapped())
+            return finish(y, aux)
         if local_b < m or local_b % m:
             # the shape-only init dummy may be too small to microbatch —
             # parameters are created identically on both paths, so it runs
             # sequentially; a REAL batch in this state must fail loudly
             # (a silent sequential fallback would idle P-1 stages)
             if self.is_initializing():
-                return run_layers(params, x)
+                return run_layers(params, x, moe_over=moe_unmapped())[0]
             raise ValueError(
                 f"local batch {local_b} (global {b} over {n_batch_shards} "
                 f"batch shards) must be a multiple of microbatches {m}")
@@ -240,35 +358,48 @@ class PipelinedEncoder(nn.Module):
                   for name, leaf in params.items()}
         perm = [(i, (i + 1) % pstages) for i in range(pstages)]
 
+        def _aux_reduce(aux_acc):
+            """Stage-local aux sums → one replicated scalar: sum stages,
+            mean over microbatches (matching the unpipelined batch-level
+            scale) and over the batch shards."""
+            aux = lax.psum(aux_acc, "pipeline") / m
+            for ax in (_batch_axes(mesh) or ()):
+                aux = lax.pmean(aux, ax)
+            return aux
+
         def pipelined(p_local, xg):
             stage = lax.axis_index("pipeline")
             mb = xg.shape[0] // m
             xs = xg.reshape((m, mb) + xg.shape[1:])
 
             def tick(carry, tt):
-                prev, out = carry
+                prev, out, aux_acc = carry
                 recv = lax.ppermute(prev, "pipeline", perm)
                 inject = lax.dynamic_index_in_dim(
                     xs, jnp.clip(tt, 0, m - 1), axis=0, keepdims=False)
                 h = jnp.where(stage == 0, inject, recv)
-                y = run_layers(p_local, h, tp_axis)
+                y, aux = run_layers(p_local, h, tp_axis)
+                u = tt - stage  # bubble ticks route zero activations:
+                valid = jnp.logical_and(u >= 0, u < m)  # mask their aux
+                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
                 idx = tt - (pstages - 1)
                 upd = lax.dynamic_update_index_in_dim(
                     out, y.astype(out.dtype), jnp.clip(idx, 0, m - 1), axis=0)
                 write = jnp.logical_and(stage == pstages - 1,
                                         jnp.logical_and(idx >= 0, idx < m))
                 out = jnp.where(write, upd, out)
-                return (y, out), None
+                return (y, out, aux_acc), None
 
             zero = jnp.zeros((mb,) + xg.shape[1:], xg.dtype)
             out0 = jnp.zeros_like(xs)
-            (last, out), _ = lax.scan(tick, (zero, out0),
-                                      jnp.arange(m + pstages - 1))
+            (last, out, aux_acc), _ = lax.scan(
+                tick, (zero, out0, jnp.float32(0.0)),
+                jnp.arange(m + pstages - 1))
             # outputs live on the last stage only; masked psum broadcasts
             out = lax.psum(
                 jnp.where(stage == pstages - 1, out, jnp.zeros_like(out)),
                 "pipeline")
-            return out.reshape(xg.shape)
+            return out.reshape(xg.shape), _aux_reduce(aux_acc)
 
         def pipelined_circular(p_local, xg):
             """Circular schedule: v chunks of k layers per stage, vM+P-1
@@ -288,7 +419,7 @@ class PipelinedEncoder(nn.Module):
                     p)
 
             def tick(carry, tt):
-                prev, wrapq, out = carry
+                prev, wrapq, out, aux_acc = carry
                 recv = lax.ppermute(prev, "pipeline", perm)
                 u = tt - stage
                 mi = jnp.mod(u, m)
@@ -313,29 +444,35 @@ class PipelinedEncoder(nn.Module):
                                                   keepdims=False)
                 h = jnp.where(stage == 0,
                               jnp.where(ci == 0, inject, parked), recv)
-                y = run_layers(chunk_params(p_local, jnp.clip(ci, 0, v - 1)),
-                               h, tp_axis)
+                y, aux = run_layers(
+                    chunk_params(p_local, jnp.clip(ci, 0, v - 1)),
+                    h, tp_axis)
+                valid = jnp.logical_and(u >= 0, u < v * m)
+                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
                 write = jnp.logical_and(stage == pstages - 1,
                                         jnp.logical_and(ci == v - 1, u >= 0))
                 upd = lax.dynamic_update_index_in_dim(
                     out, y.astype(out.dtype), mi_c, axis=0)
                 out = jnp.where(write, upd, out)
-                return (y, wrapq, out), None
+                return (y, wrapq, out, aux_acc), None
 
             zero = jnp.zeros((mb,) + xg.shape[1:], xg.dtype)
-            (last, _wq, out), _ = lax.scan(
-                tick, (zero, jnp.zeros_like(xs), jnp.zeros_like(xs)),
+            (last, _wq, out, aux_acc), _ = lax.scan(
+                tick,
+                (zero, jnp.zeros_like(xs), jnp.zeros_like(xs),
+                 jnp.float32(0.0)),
                 jnp.arange(v * m + pstages - 1))
             out = lax.psum(
                 jnp.where(stage == pstages - 1, out, jnp.zeros_like(out)),
                 "pipeline")
-            return out.reshape(xg.shape)
+            return out.reshape(xg.shape), _aux_reduce(aux_acc)
 
         from ..parallel.mesh import shard_map_compat
         body = pipelined if v == 1 else pipelined_circular
         fn = shard_map_compat(body, mesh, in_specs=(p_spec, x_spec),
-                              out_specs=x_spec)
-        return fn(params, x)
+                              out_specs=(x_spec, P()))
+        y, aux = fn(params, x)
+        return finish(y, aux)
 
 
 def circular_layer_order(depth: int, pstages: int, interleave: int):
@@ -373,7 +510,9 @@ def pack_encoder_params(vit_params: dict, depth: int, pstages: int = 1,
     """Stack a standard per-block ViT param tree (EncoderBlock_i modules)
     into the PipelinedEncoder layout — checkpoint migration between the
     unpipelined and pipelined parameterizations. ``pstages``/``interleave``
-    select the circular stacking order (no-ops at their defaults)."""
+    select the circular stacking order (no-ops at their defaults).
+    Handles both MLP kinds: dense (Dense_0/Dense_1) and Switch MoE
+    (SwitchMlp_0 → router/moe leaves)."""
     order = circular_layer_order(depth, max(1, pstages), interleave)
 
     def block(i):
@@ -382,7 +521,7 @@ def pack_encoder_params(vit_params: dict, depth: int, pstages: int = 1,
     def stack(fn):
         return jnp.stack([jnp.asarray(fn(block(int(i)))) for i in order])
 
-    return {
+    out = {
         "ln1_scale": stack(lambda b: b["LayerNorm_0"]["scale"]),
         "ln1_bias": stack(lambda b: b["LayerNorm_0"]["bias"]),
         "qkv_kernel": stack(
@@ -391,8 +530,23 @@ def pack_encoder_params(vit_params: dict, depth: int, pstages: int = 1,
             lambda b: b["MultiHeadAttention_0"]["proj"]["kernel"]),
         "ln2_scale": stack(lambda b: b["LayerNorm_1"]["scale"]),
         "ln2_bias": stack(lambda b: b["LayerNorm_1"]["bias"]),
-        "mlp_w1": stack(lambda b: b["Dense_0"]["kernel"]),
-        "mlp_b1": stack(lambda b: b["Dense_0"]["bias"]),
-        "mlp_w2": stack(lambda b: b["Dense_1"]["kernel"]),
-        "mlp_b2": stack(lambda b: b["Dense_1"]["bias"]),
     }
+    if "SwitchMlp_0" in block(0):
+        out.update({
+            "router_kernel": stack(
+                lambda b: b["SwitchMlp_0"]["router"]["kernel"]),
+            "router_bias": stack(
+                lambda b: b["SwitchMlp_0"]["router"]["bias"]),
+            "moe_w1": stack(lambda b: b["SwitchMlp_0"]["w1"]),
+            "moe_bias1": stack(lambda b: b["SwitchMlp_0"]["bias1"]),
+            "moe_w2": stack(lambda b: b["SwitchMlp_0"]["w2"]),
+            "moe_bias2": stack(lambda b: b["SwitchMlp_0"]["bias2"]),
+        })
+    else:
+        out.update({
+            "mlp_w1": stack(lambda b: b["Dense_0"]["kernel"]),
+            "mlp_b1": stack(lambda b: b["Dense_0"]["bias"]),
+            "mlp_w2": stack(lambda b: b["Dense_1"]["kernel"]),
+            "mlp_b2": stack(lambda b: b["Dense_1"]["bias"]),
+        })
+    return out

@@ -209,9 +209,6 @@ class VisionTransformer(nn.Module):
                 raise ValueError(
                     "pipeline parallelism supports dense/flash attention "
                     f"(got attention_impl={self.attention_impl!r})")
-            if self.num_experts > 0:
-                raise ValueError(
-                    "pipeline parallelism does not support MoE blocks yet")
             from .pipeline import PipelinedEncoder
             x = PipelinedEncoder(depth=self.depth, num_heads=self.num_heads,
                                  mlp_ratio=self.mlp_ratio, dtype=self.dtype,
@@ -220,6 +217,9 @@ class VisionTransformer(nn.Module):
                                  interleave=self.pipeline_interleave,
                                  remat=self.remat,
                                  attention_impl=impl,
+                                 num_experts=self.num_experts,
+                                 expert_capacity_factor=self.expert_capacity_factor,
+                                 moe_top_k=self.moe_top_k,
                                  name="encoder")(x)
         else:
             block = EncoderBlock

@@ -21,9 +21,14 @@ def stacked_encoder_spec(leaf_name: str, ndim: int, tensor: int = 1) -> P:
     on the leading depth axis, plus (when ``tensor`` > 1) the Megatron
     placement on the head/hidden axis — whole heads of qkv (L,D,3,H,hd) and
     proj (L,H,hd,D), columns of mlp_w1 (L,D,F)/mlp_b1 (L,F), rows of
-    mlp_w2 (L,F,D). Single source of truth for BOTH the training-state
+    mlp_w2 (L,F,D) — and, for the MoE pipeline (pp×ep), ``expert`` on the
+    expert-stacked axis of moe_w1/b1/w2/b2 (L,E,...) while the router
+    stays replicated across ``expert`` (routing must be globally
+    consistent). Single source of truth for BOTH the training-state
     sharding (param_sharding_rule) and the pipeline shard_map in_specs
     (models/pipeline.py) — they must agree or every step reshards."""
+    if leaf_name.startswith("moe_"):
+        return P(*(("pipeline", "expert") + (None,) * (ndim - 2)))
     if tensor > 1:
         spec = {
             "qkv_kernel": P("pipeline", None, None, "tensor", None),

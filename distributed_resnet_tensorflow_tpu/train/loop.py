@@ -272,14 +272,15 @@ class Trainer:
                     "MoE blocks do not compose with tensor parallelism "
                     "yet; shard experts over mesh.expert instead")
             if self.mesh.shape.get("pipeline", 1) > 1:
-                # pp composes with dp/fsdp (microbatch over local batch) and
-                # tp (Megatron psums inside each stage, models/pipeline.py);
-                # seq/expert have no stacked-stage implementation yet
-                for axis in ("seq", "expert"):
-                    if self.mesh.shape.get(axis, 1) > 1:
-                        raise ValueError(
-                            "pipeline parallelism does not compose with "
-                            f"{axis!r} yet; use pipeline x data x tensor")
+                # pp composes with dp/fsdp (microbatch over local batch),
+                # tp (Megatron psums inside each stage) and ep (stacked-
+                # stage Switch MoE, models/pipeline.py _moe_mlp; note
+                # ep×tp is already excluded by the blanket MoE×tensor
+                # rejection above); seq has no stacked-stage implementation
+                if self.mesh.shape.get("seq", 1) > 1:
+                    raise ValueError(
+                        "pipeline parallelism does not compose with "
+                        "'seq' yet; use pipeline x data x {tensor|expert}")
         self.model = create_model(cfg.model, cfg.data.dataset,
                                   remat=cfg.train.remat, bn_groups=bn_groups,
                                   mesh=self.mesh)
