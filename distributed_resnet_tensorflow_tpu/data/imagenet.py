@@ -83,6 +83,9 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
     native transform both release it); the process pool is the escape
     hatch for hosts where the python-side feeder contends
     (tools/input_scaling.py measures both, docs/input_scaling_r4.json).
+    Workers start via forkserver/spawn (fork from a threaded parent can
+    inherit held locks), so the calling program needs the standard
+    ``if __name__ == "__main__"`` guard multiprocessing requires.
     """
     files = dataset_filenames(data_dir, mode)
     if num_shards > 1:
@@ -161,10 +164,20 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
 
     if use_procs:
         import multiprocessing as mp
-        ctx = mp.get_context("fork")
+        # NOT "fork": the parent is multi-threaded by the time an iterator
+        # is built (JAX runtime threads, earlier iterators' feeders), and a
+        # child forked while another thread holds a lock (malloc, logging)
+        # can deadlock — observed nondeterministically in round 4.
+        # forkserver forks from a clean single-threaded server process;
+        # spawn is the fallback where it's unavailable. The worker body
+        # (_decode_worker) is module-level and numpy/PIL-only, so both
+        # start methods can import it.
+        try:
+            ctx = mp.get_context("forkserver")
+        except ValueError:  # platform without forkserver
+            ctx = mp.get_context("spawn")
         in_q = ctx.Queue(maxsize=4 * batch_size)
         out_q = ctx.Queue(maxsize=max(2, prefetch_batches) * batch_size)
-        # processes FIRST (fork before this iterator spawns any thread)
         workers = [
             ctx.Process(target=_decode_worker,
                         args=(in_q, out_q, seed * 7919 + i, is_train,
@@ -173,9 +186,9 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
             for i in range(n_workers)]
         for w in workers:
             w.start()
-        # parent only, AFTER the forks (children must keep normal join
-        # semantics so their final puts flush at exit): without this, an
-        # abandoned iterator leaves the parent's atexit joining a queue
+        # parent only, AFTER the workers start (children must keep normal
+        # join semantics so their final puts flush at exit): without this,
+        # an abandoned iterator leaves the parent's atexit joining a queue
         # feeder thread that can never drain once workers are gone
         in_q.cancel_join_thread()
         out_q.cancel_join_thread()
@@ -299,11 +312,37 @@ def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
                  emit_uint8, stop=None):
     from .preprocessing import (RGB_MEANS, eval_crop_from_bytes,
                                 train_crop_from_bytes)
+    import queue as queue_mod
     wrng = np.random.RandomState(wseed)
+
+    def put_checked(item) -> bool:
+        """Timed put in thread mode so `stop` is observed even on a FULL
+        out_q (decoders outpacing an abandoned consumer park here, not in
+        get). Process mode (stop=None) keeps the blocking put — workers
+        are terminate()d."""
+        if stop is None:
+            out_q.put(item)
+            return True
+        while not stop.is_set():
+            try:
+                out_q.put(item, timeout=0.2)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
     while stop is None or not stop.is_set():
-        item = in_q.get()
+        # timed get in thread mode so `stop` is observed between items: an
+        # abandoned iterator (eval warmup, a polling evaluator sized below
+        # the dataset) sets `stop` while workers sit in get(); a blocking
+        # get would strand num_decode_threads daemon threads per iterator,
+        # growing unboundedly in a long-lived poll loop.
+        try:
+            item = in_q.get(timeout=None if stop is None else 0.2)
+        except queue_mod.Empty:
+            continue
         if item is _END or isinstance(item, _EndMarker):
-            out_q.put(_END)
+            put_checked(_END)
             return
         data, label = item
         if is_train:
@@ -314,7 +353,8 @@ def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
                                        use_native=native_decode)
         if not emit_uint8:
             img = img.astype(np.float32) / 255.0 - RGB_MEANS
-        out_q.put((img, label))
+        if not put_checked((img, label)):
+            return
 
 
 def _decode_worker(in_q, out_q, wseed, is_train, image_size, native_decode,

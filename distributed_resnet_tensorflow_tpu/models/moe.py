@@ -220,6 +220,19 @@ class SwitchMlp(nn.Module):
             else:
                 shards = self._a2a_shards()
                 mode = "a2a" if n_tokens % shards == 0 else "einsum"
+                # the round-4 a2a path changed what 'auto' resolves to on
+                # an expert-sharded mesh, and with it the capacity
+                # semantics (group-local vs global cumsum) — say so at
+                # trace time so users replaying pre-round-4 runs know to
+                # pin dispatch='einsum' (PARITY.md §2.10 records the
+                # change). Unsharded meshes keep the unchanged gather
+                # semantics — nothing to announce.
+                import logging
+                logging.getLogger(__name__).info(
+                    "SwitchMlp dispatch='auto' resolved to %r (mesh "
+                    "expert axis %d); pin model.vit_moe_dispatch to fix "
+                    "routing numerics across versions", mode,
+                    self.mesh.shape.get("expert", 1))
         if mode not in ("einsum", "gather", "a2a"):
             raise ValueError(f"unknown moe dispatch mode {mode!r}")
 

@@ -145,10 +145,23 @@ class StemConv(nn.Module):
 
 
 class BatchNormRelu(nn.Module):
-    """BN (momentum 0.997, eps 1e-5 — reference resnet_model_official.py:37-48)
-    followed by ReLU. Stats kept in float32. ``groups=1`` → cross-replica BN
-    (global moments); ``groups=G`` → per-replica/reference BN numerics (see
-    ops/batch_norm.py). ``axis_name`` adds explicit pmean under shard_map."""
+    """Normalization + ReLU, dispatched on ``norm``:
+
+      * "batch"  — BN (momentum 0.997, eps 1e-5 — reference
+        resnet_model_official.py:37-48). Stats in float32. ``groups=1`` →
+        cross-replica BN (global moments); ``groups=G`` → per-replica/
+        reference BN numerics (ops/batch_norm.py). ``axis_name`` adds
+        explicit pmean under shard_map.
+      * "frozen" — BN applied from the RUNNING statistics even in training
+        (the trainable frozen-BN fine-tune contract): scale/bias still
+        learn, the batch-moment passes and their cross-replica semantics
+        disappear, stats never update. From-scratch this is a learned
+        per-channel affine (stats stay at init 0/1); from a checkpoint it
+        is classic frozen-BN fine-tuning.
+      * "group"  — GroupNorm over ``norm_groups`` channel groups
+        (ops/batch_norm.ChannelGroupNorm): batch-independent, stateless,
+        no train/eval split — the BN-free training contract.
+    """
 
     momentum: float = 0.997
     epsilon: float = 1e-5
@@ -157,18 +170,29 @@ class BatchNormRelu(nn.Module):
     groups: int = 1
     relu: bool = True
     stat_subsample: int = 1
+    norm: str = "batch"
+    norm_groups: int = 32
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
-        from ..ops.batch_norm import GroupedBatchNorm
-        x = GroupedBatchNorm(
-            momentum=self.momentum,
-            epsilon=self.epsilon,
-            dtype=self.dtype,
-            groups=self.groups,
-            axis_name=self.axis_name,
-            stat_subsample=self.stat_subsample,
-        )(x, train)
+        if self.norm == "group":
+            from ..ops.batch_norm import ChannelGroupNorm
+            x = ChannelGroupNorm(groups=self.norm_groups,
+                                 epsilon=self.epsilon,
+                                 dtype=self.dtype)(x, train)
+        elif self.norm in ("batch", "frozen"):
+            from ..ops.batch_norm import GroupedBatchNorm
+            x = GroupedBatchNorm(
+                momentum=self.momentum,
+                epsilon=self.epsilon,
+                dtype=self.dtype,
+                groups=self.groups,
+                axis_name=self.axis_name,
+                stat_subsample=self.stat_subsample,
+            )(x, train and self.norm != "frozen")
+        else:
+            raise ValueError(
+                f"model.norm must be batch|frozen|group, got {self.norm!r}")
         if self.relu:
             x = nn.relu(x)
         return x
@@ -188,13 +212,16 @@ class BuildingBlock(nn.Module):
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
     bn_stat_subsample: int = 1
+    norm: str = "batch"
+    norm_groups: int = 32
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
         bn = partial(BatchNormRelu, momentum=self.bn_momentum,
                      epsilon=self.bn_epsilon, dtype=self.dtype,
                      axis_name=self.axis_name, groups=self.bn_groups,
-                     stat_subsample=self.bn_stat_subsample)
+                     stat_subsample=self.bn_stat_subsample,
+                     norm=self.norm, norm_groups=self.norm_groups)
         conv = partial(ConvFixedPadding, dtype=self.dtype)
         shortcut = x
         x = bn()(x, train)
@@ -219,13 +246,16 @@ class BottleneckBlock(nn.Module):
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
     bn_stat_subsample: int = 1
+    norm: str = "batch"
+    norm_groups: int = 32
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
         bn = partial(BatchNormRelu, momentum=self.bn_momentum,
                      epsilon=self.bn_epsilon, dtype=self.dtype,
                      axis_name=self.axis_name, groups=self.bn_groups,
-                     stat_subsample=self.bn_stat_subsample)
+                     stat_subsample=self.bn_stat_subsample,
+                     norm=self.norm, norm_groups=self.norm_groups)
         conv = partial(ConvFixedPadding, dtype=self.dtype)
         shortcut = x
         x = bn()(x, train)
@@ -254,6 +284,8 @@ class BlockLayer(nn.Module):
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
     bn_stat_subsample: int = 1
+    norm: str = "batch"
+    norm_groups: int = 32
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool) -> jax.Array:
@@ -271,6 +303,7 @@ class BlockLayer(nn.Module):
                 bn_momentum=self.bn_momentum,
                 bn_epsilon=self.bn_epsilon,
                 bn_stat_subsample=self.bn_stat_subsample,
+                norm=self.norm, norm_groups=self.norm_groups,
             )(x, train)
         return x
 
@@ -290,6 +323,8 @@ class CifarResNetV2(nn.Module):
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
     bn_stat_subsample: int = 1
+    norm: str = "batch"
+    norm_groups: int = 32
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -312,11 +347,14 @@ class CifarResNetV2(nn.Module):
                 bn_groups=self.bn_groups, remat=self.remat,
                 bn_momentum=self.bn_momentum, bn_epsilon=self.bn_epsilon,
                 bn_stat_subsample=self.bn_stat_subsample,
+                norm=self.norm, norm_groups=self.norm_groups,
             )(x, train)
         x = BatchNormRelu(momentum=self.bn_momentum, epsilon=self.bn_epsilon,
                           dtype=self.dtype, axis_name=self.axis_name,
                           groups=self.bn_groups,
-                          stat_subsample=self.bn_stat_subsample)(x, train)
+                          stat_subsample=self.bn_stat_subsample,
+                          norm=self.norm,
+                          norm_groups=self.norm_groups)(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global avg pool (8x8 at 32px input)
         x = x.astype(jnp.float32)
         return nn.Dense(self.num_classes,
@@ -337,6 +375,8 @@ class ImageNetResNetV2(nn.Module):
     bn_momentum: float = 0.997
     bn_epsilon: float = 1e-5
     bn_stat_subsample: int = 1
+    norm: str = "batch"
+    norm_groups: int = 32
     stem_space_to_depth: bool = False
 
     @nn.compact
@@ -365,11 +405,14 @@ class ImageNetResNetV2(nn.Module):
                 remat=self.remat, bn_momentum=self.bn_momentum,
                 bn_epsilon=self.bn_epsilon,
                 bn_stat_subsample=self.bn_stat_subsample,
+                norm=self.norm, norm_groups=self.norm_groups,
             )(x, train)
         x = BatchNormRelu(momentum=self.bn_momentum, epsilon=self.bn_epsilon,
                           dtype=self.dtype, axis_name=self.axis_name,
                           groups=self.bn_groups,
-                          stat_subsample=self.bn_stat_subsample)(x, train)
+                          stat_subsample=self.bn_stat_subsample,
+                          norm=self.norm,
+                          norm_groups=self.norm_groups)(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global avg pool (7x7 at 224px input)
         x = x.astype(jnp.float32)
         return nn.Dense(self.num_classes,
@@ -420,7 +463,8 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
             width_multiplier=model_cfg.width_multiplier,
             dtype=dtype, axis_name=axis_name, bn_groups=bn_groups, remat=remat,
             bn_momentum=model_cfg.bn_momentum, bn_epsilon=model_cfg.bn_epsilon,
-            bn_stat_subsample=model_cfg.bn_stat_subsample)
+            bn_stat_subsample=model_cfg.bn_stat_subsample,
+            norm=model_cfg.norm, norm_groups=model_cfg.gn_groups)
     if dataset == "imagenet":
         return ImageNetResNetV2(
             resnet_size=model_cfg.resnet_size,
@@ -428,6 +472,7 @@ def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
             dtype=dtype, axis_name=axis_name, bn_groups=bn_groups, remat=remat,
             bn_momentum=model_cfg.bn_momentum, bn_epsilon=model_cfg.bn_epsilon,
             bn_stat_subsample=model_cfg.bn_stat_subsample,
+            norm=model_cfg.norm, norm_groups=model_cfg.gn_groups,
             stem_space_to_depth=model_cfg.stem_space_to_depth)
     raise ValueError(f"unknown dataset {dataset!r}")
 

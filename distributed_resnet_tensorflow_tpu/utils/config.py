@@ -41,6 +41,14 @@ class ModelConfig:
     # (ops/batch_norm.py module docstring has the measured story). 1 = exact
     # moments (default everywhere; reference numerics).
     bn_stat_subsample: int = 1
+    # normalization contract (ResNet family): "batch" = reference BN
+    # semantics (default); "frozen" = BN from running stats even in
+    # training (trainable scale/bias, no stat passes — the fine-tune
+    # contract); "group" = GroupNorm (batch-independent, stateless — the
+    # BN-free training contract; docs/perf_norm_r5.md has the measured MFU
+    # of all three). models/resnet.py BatchNormRelu dispatches on this.
+    norm: str = "batch"
+    gn_groups: int = 32               # GroupNorm group count (norm="group")
     # evaluate the ImageNet 7x7/2 stem via space-to-depth (input [N,224,224,3]
     # -> [N,115,115,12], kernel 7x7x3 -> 4x4x12): mathematically the same
     # conv, but the contraction no longer has the MXU-hostile 3-channel

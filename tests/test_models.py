@@ -150,3 +150,100 @@ def test_stem_space_to_depth_parity():
     np.testing.assert_allclose(
         np.asarray(g_s2d["params"]["kernel"]),
         np.asarray(g_plain["params"]["kernel"]), rtol=1e-4, atol=1e-4)
+
+
+# ---- normalization contract (model.norm = batch | frozen | group) --------
+
+def test_group_norm_matches_manual():
+    """ChannelGroupNorm == hand-computed GroupNorm (per sample, per group
+    over H·W·C/G) at f32."""
+    from distributed_resnet_tensorflow_tpu.ops.batch_norm import (
+        ChannelGroupNorm)
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 5, 5, 8).astype(np.float32)
+    m = ChannelGroupNorm(groups=4, epsilon=1e-5, dtype=jnp.float32)
+    variables = m.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    y = np.asarray(m.apply(variables, jnp.asarray(x)))
+    xg = x.reshape(3, 5, 5, 4, 2)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    ref = ((xg - mean) / np.sqrt(var + 1e-5)).reshape(x.shape)
+    np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_group_norm_affine_params_learnable():
+    from distributed_resnet_tensorflow_tpu.ops.batch_norm import (
+        ChannelGroupNorm)
+    m = ChannelGroupNorm(groups=2, dtype=jnp.float32)
+    x = jnp.ones((2, 4, 4, 4), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    assert set(variables["params"]) == {"scale", "bias"}
+    assert "batch_stats" not in variables
+
+
+def test_effective_gn_groups():
+    from distributed_resnet_tensorflow_tpu.ops.batch_norm import (
+        effective_gn_groups)
+    assert effective_gn_groups(64, 32) == 32    # imagenet stages
+    assert effective_gn_groups(2048, 32) == 32
+    assert effective_gn_groups(16, 32) == 16    # narrow cifar stage
+    assert effective_gn_groups(48, 32) == 16    # non-dividing: gcd
+    assert effective_gn_groups(7, 32) == 7
+
+
+def test_norm_group_resnet_stateless_and_batch_independent():
+    """norm='group': no batch_stats anywhere; train==eval forward; each
+    sample's output independent of the rest of the batch."""
+    model = CifarResNetV2(resnet_size=8, num_classes=4, dtype=jnp.float32,
+                          norm="group")
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 16, 16, 3).astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert "batch_stats" not in variables or not variables["batch_stats"]
+    eval_out = model.apply(variables, x, train=False)
+    train_out, mutated = model.apply(variables, x, train=True,
+                                     mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(eval_out), np.asarray(train_out),
+                               rtol=1e-6)
+    solo = model.apply(variables, x[:1], train=True,
+                       mutable=["batch_stats"])[0]
+    np.testing.assert_allclose(np.asarray(solo[0]),
+                               np.asarray(train_out[0]), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_norm_frozen_train_equals_eval_and_stats_fixed():
+    """norm='frozen': training forward uses running stats (== eval
+    forward), and the stats don't move."""
+    model = CifarResNetV2(resnet_size=8, num_classes=4, dtype=jnp.float32,
+                          norm="frozen")
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 16, 16, 3).astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert variables["batch_stats"]  # BN variables still exist (fine-tune)
+    eval_out = model.apply(variables, x, train=False)
+    train_out, mutated = model.apply(variables, x, train=True,
+                                     mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(eval_out), np.asarray(train_out),
+                               rtol=1e-6)
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+
+
+def test_norm_unknown_rejected():
+    model = CifarResNetV2(resnet_size=8, num_classes=4, norm="layer")
+    with pytest.raises(ValueError, match="batch|frozen|group"):
+        _init_and_apply(model, (1, 16, 16, 3))
+
+
+def test_create_model_norm_threading():
+    cfg = ModelConfig(resnet_size=8, num_classes=4, norm="group",
+                      gn_groups=16, compute_dtype="float32")
+    model = create_model(cfg, "cifar10")
+    assert model.norm == "group" and model.norm_groups == 16
+    cfg_i = ModelConfig(resnet_size=18, num_classes=10, norm="frozen",
+                        compute_dtype="float32")
+    model_i = create_model(cfg_i, "imagenet")
+    assert model_i.norm == "frozen"

@@ -386,3 +386,46 @@ def test_segmented_training_does_not_skip_batches():
     tr.train(it, num_steps=6, start_step=3)
     # 9 steps total; prefetch may hold up to 2 batches in flight beyond that
     assert len(consumed) <= 9 + 2
+
+
+def test_loss_decreases_with_group_norm():
+    """The BN-free contract trains: same convergence oracle as the BN path
+    (VERDICT r4 #1 — the GroupNorm escape hatch must exist AND learn)."""
+    cfg = _tiny_cfg()
+    cfg.model.norm = "group"
+    tr = Trainer(cfg)
+    tr.init_state()
+    assert not tr.state.batch_stats  # stateless contract
+    it = learnable_synthetic_iterator(16, 8, 4, seed=3)
+    losses = []
+    step_fn = tr.jitted_train_step()
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import shard_batch
+    for i in range(30):
+        batch = shard_batch(next(it), tr.mesh)
+        tr.state, m = step_fn(tr.state, batch)
+        losses.append(float(m["cross_entropy"]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, losses
+
+
+def test_loss_decreases_with_frozen_bn():
+    """The frozen-BN fine-tune contract also trains from scratch (stats
+    pinned at init 0/1 — a learned affine)."""
+    cfg = _tiny_cfg()
+    cfg.model.norm = "frozen"
+    tr = Trainer(cfg)
+    tr.init_state()
+    # snapshot to numpy: the jitted step donates the state buffers
+    before = [np.asarray(x)
+              for x in jax.tree_util.tree_leaves(tr.state.batch_stats)]
+    it = learnable_synthetic_iterator(16, 8, 4, seed=3)
+    losses = []
+    step_fn = tr.jitted_train_step()
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import shard_batch
+    for i in range(30):
+        batch = shard_batch(next(it), tr.mesh)
+        tr.state, m = step_fn(tr.state, batch)
+        losses.append(float(m["cross_entropy"]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, losses
+    after = jax.tree_util.tree_leaves(tr.state.batch_stats)
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
