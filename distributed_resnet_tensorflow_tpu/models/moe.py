@@ -148,14 +148,24 @@ def combine_from_slots(assigned, eout: jax.Array, n: int, capacity: int,
     return out
 
 
-def expert_ffn(ein: jax.Array, w1, b1, w2, b2, dtype) -> jax.Array:
+def expert_ffn(ein: jax.Array, w1, b1, w2, b2, dtype,
+               tp_axis=None) -> jax.Array:
     """(E, C, D) expert inputs → (E, C, D) outputs (E may be a local block
-    of the stacked expert params)."""
+    of the stacked expert params).
+
+    ``tp_axis``: Megatron tensor parallelism INSIDE each expert (round 5,
+    MoE×tensor): the caller hands hidden-dim shards of w1/b1 (columns) and
+    w2 (rows); the down-projection then yields partial sums that one
+    ``lax.psum`` completes — same collective count as the dense Megatron
+    MLP. b2 is replicated and added AFTER the psum (inside it would be
+    multiplied by the axis size). tp_axis=None is the exact same math."""
     h = jnp.einsum("ecd,edf->ecf", ein, w1.astype(dtype)) \
         + b1[:, None, :].astype(dtype)
     h = nn.gelu(h)
-    return jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype)) \
-        + b2[:, None, :].astype(dtype)
+    out = jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype))
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out + b2[:, None, :].astype(dtype)
 
 
 class SwitchMlp(nn.Module):
@@ -287,8 +297,8 @@ class SwitchMlp(nn.Module):
         out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), eout)
         return out.reshape(b, t, d)
 
-    def _expert_mlp(self, ein, params):
-        return expert_ffn(ein, *params, self.dtype)
+    def _expert_mlp(self, ein, params, tp_axis=None):
+        return expert_ffn(ein, *params, self.dtype, tp_axis=tp_axis)
 
     def _gather_dispatch(self, flat_x, flat_probs, capacity, params):
         """O(N + E·C) dispatch for ONE capacity group: scatter the kept
@@ -334,6 +344,14 @@ class SwitchMlp(nn.Module):
         e_loc = e // ep
         dtype, top_k = self.dtype, self.top_k
         expert_mlp = self._expert_mlp
+        # MoE×tensor (round 5): each expert's FFN is Megatron-sharded over
+        # `tensor` (w1/b1 columns, w2 rows — parallel/sharding.py); the
+        # tokens stay REPLICATED across `tensor` (unmentioned in `tok`),
+        # so every tensor peer runs identical routing and exchanges, and
+        # one psum inside expert_ffn completes the down-projection.
+        tp = mesh.shape.get("tensor", 1)
+        f = params[0].shape[-1]
+        tp_axis = "tensor" if (tp > 1 and f % tp == 0) else None
 
         def body(xs, ps, w1l, b1l, w2l, b2l):
             # xs (n_sub, d) this device's token sub-shard; ps (n_sub, e);
@@ -347,7 +365,7 @@ class SwitchMlp(nn.Module):
             # after a2a row p = peer p's tokens for MY chunk
             ein = jax.lax.all_to_all(ein, "expert", 0, 0)
             ein = ein.transpose(1, 0, 2, 3).reshape(e_loc, ep * cap, d)
-            eo = expert_mlp(ein, (w1l, b1l, w2l, b2l))
+            eo = expert_mlp(ein, (w1l, b1l, w2l, b2l), tp_axis)
             eo = eo.reshape(e_loc, ep, cap, d).transpose(1, 0, 2, 3)
             # send peer p's token outputs home; receive mine from each chunk
             eo = jax.lax.all_to_all(eo, "expert", 0, 0)
@@ -355,10 +373,11 @@ class SwitchMlp(nn.Module):
             return combine_from_slots(assigned, eout, n_sub, cap, dtype, e)
 
         tok = P(("data", "fsdp", "expert"), None)
+        tps = "tensor" if tp_axis else None
         sharded = shard_map_compat(
             body, mesh,
-            in_specs=(tok, tok, P("expert", None, None), P("expert", None),
-                      P("expert", None, None), P("expert", None)),
+            in_specs=(tok, tok, P("expert", None, tps), P("expert", tps),
+                      P("expert", tps, None), P("expert", None)),
             out_specs=tok)
         w1, b1, w2, b2 = params
         return sharded(flat_x, flat_probs, w1, b1, w2, b2)

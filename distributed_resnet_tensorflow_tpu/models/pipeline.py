@@ -70,7 +70,7 @@ def _layer_norm(x, scale, bias):
 
 
 def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense",
-                 moe=None):
+                 moe=None, seq=None):
     """One encoder block from a stacked-param slice ``p`` — the explicit-math
     twin of transformer.EncoderBlock (kept in lockstep; exact-parity test:
     tests/test_pipeline.py).
@@ -84,11 +84,20 @@ def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense",
     exactly the Megatron count. Replicated tensors (x, LN params, mlp_b2)
     stay replicated across ``tp_axis``.
 
-    ``attn_impl``: "dense" (XLA reference) or the fused Pallas flash kernel
+    ``attn_impl``: "dense" (XLA reference), the fused Pallas flash kernel
     ("flash" / "flash_interpret" for CPU tests) — long-context attention
     inside pipeline stages (round 4; the pallas_call runs fine under the
     pipeline shard_map, and the kernel's custom vjp rides the transposed
-    scan schedule like any other block op).
+    scan schedule like any other block op) — or ring attention
+    ("ring" / "ring_interpret", round 5, pp×seq): tokens arrive sharded
+    over the ``seq`` mesh axis (``seq`` = static (axis_name, n_shards)),
+    kv chunks rotate the ICI ring via ppermute INSIDE the pipeline tick,
+    and the ring's custom backward rides the transposed scan exactly like
+    flash did. "ring" runs the Pallas flash inner block on TPU and the
+    pure-lax online recurrence elsewhere (the ring_attention_sharded auto
+    rule); "ring_interpret" forces the interpreter kernels (CPU parity
+    tests). LayerNorm/MLP are token-pointwise and partition cleanly over
+    the extra token sharding.
 
     When ``p`` carries MoE leaves (moe_w1/...), the MLP is a Switch
     mixture (pp×ep, see _moe_mlp); ``moe`` is the static
@@ -105,9 +114,21 @@ def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense",
     elif attn_impl == "dense":
         from ..ops.attention import attention
         o = attention(q, k, v)  # local heads only under tp
+    elif attn_impl in ("ring", "ring_interpret"):
+        from ..ops.attention import resolve_ring_kernel
+        seq_axis, n_seq = seq
+        kern = resolve_ring_kernel(
+            "flash_interpret" if attn_impl == "ring_interpret" else "auto")
+        if kern == "lax":
+            from ..ops.attention import ring_attention
+            o = ring_attention(q, k, v, seq_axis)
+        else:
+            from ..ops.pallas.flash_attention import ring_flash_attention
+            o = ring_flash_attention(q, k, v, seq_axis, n_seq, False,
+                                     kern == "flash_interpret")
     else:
         raise ValueError(
-            f"pipelined blocks support dense/flash attention, "
+            f"pipelined blocks support dense/flash/ring attention, "
             f"got {attn_impl!r}")
     o = jnp.einsum("bthk,hkd->btd", o, p["proj_kernel"].astype(dtype))
     if tp_axis is not None:
@@ -116,7 +137,7 @@ def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense",
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     if "moe_w1" in p:
         top_k, cap_factor, ep_axis = moe or (1, 1.25, None)
-        h, aux = _moe_mlp(p, h, dtype, top_k, cap_factor, ep_axis)
+        h, aux = _moe_mlp(p, h, dtype, top_k, cap_factor, ep_axis, tp_axis)
         return x + h, aux
     h = jnp.einsum("btd,df->btf", h, p["mlp_w1"].astype(dtype)) \
         + p["mlp_b1"].astype(dtype)
@@ -128,7 +149,8 @@ def _block_apply(p, x, num_heads, dtype, tp_axis=None, attn_impl="dense",
     return x + h, jnp.float32(0.0)
 
 
-def _moe_mlp(p, h, dtype, top_k=1, capacity_factor=1.25, ep_axis=None):
+def _moe_mlp(p, h, dtype, top_k=1, capacity_factor=1.25, ep_axis=None,
+             tp_axis=None):
     """Switch MoE MLP from stacked-slice params, expert-sharded over
     ``ep_axis`` inside the pipeline shard_map (pp×ep, round 4).
 
@@ -147,7 +169,13 @@ def _moe_mlp(p, h, dtype, top_k=1, capacity_factor=1.25, ep_axis=None):
     Routing/dispatch/combine/FFN math is the SHARED models/moe.py
     machinery (_route_assign, gather_slot_table, combine_from_slots,
     expert_ffn, switch_aux_loss) — the only pipeline-specific parts are
-    the per-device expert offset and the completing psum."""
+    the per-device expert offset and the completing psum.
+
+    ``tp_axis`` (pp×ep×tp, round 5): each local expert's FFN is
+    additionally Megatron-split over the tensor axis — the caller's
+    stacked params arrive column-/row-sharded (stacked_encoder_spec) and
+    expert_ffn's internal psum completes the down-projection before the
+    expert-axis combine psum."""
     import math
     from .moe import (_route_assign, combine_from_slots, expert_ffn,
                       gather_slot_table, switch_aux_loss)
@@ -168,7 +196,8 @@ def _moe_mlp(p, h, dtype, top_k=1, capacity_factor=1.25, ep_axis=None):
         [flat.astype(dtype), jnp.zeros((1, d), dtype)], axis=0)
     ein = jnp.take(padded, sel, axis=0).reshape(e_loc, cap, d)
     eout = expert_ffn(ein, p["moe_w1"], p["moe_bias1"], p["moe_w2"],
-                      p["moe_bias2"], dtype).reshape(e_loc * cap, d)
+                      p["moe_bias2"], dtype,
+                      tp_axis=tp_axis).reshape(e_loc * cap, d)
     out = combine_from_slots(assigned, eout, n, cap, dtype, e_loc,
                              e_lo=my * e_loc)
     if ep_axis is not None:
@@ -261,11 +290,25 @@ class PipelinedEncoder(nn.Module):
 
         tp = self.mesh.shape.get("tensor", 1) if self.mesh is not None else 1
         tp_axis = "tensor" if (tp > 1 and pstages > 1) else None
+        sp = self.mesh.shape.get("seq", 1) if self.mesh is not None else 1
+        ring = self.attention_impl in ("ring", "ring_interpret")
+        if sp > 1 and not ring:
+            raise ValueError(
+                "pipeline x seq runs ring attention inside the stage "
+                "blocks; set attention_impl='ring' "
+                f"(got {self.attention_impl!r})")
+        if ring and sp <= 1:
+            raise ValueError(
+                "attention_impl='ring' in the pipelined encoder requires "
+                "mesh.seq > 1")
+        if ring and t % sp:
+            raise ValueError(f"{t} tokens not divisible by seq axis {sp}")
+        seq_static = ("seq", sp) if ring else None
 
         block_fn = _block_apply
         if self.remat:
             block_fn = jax.checkpoint(
-                _block_apply, static_argnums=(2, 3, 4, 5, 6))
+                _block_apply, static_argnums=(2, 3, 4, 5, 6, 7))
         moe_static = None
         if self.num_experts > 0:
             ep = self.mesh.shape.get("expert", 1) \
@@ -277,15 +320,20 @@ class PipelinedEncoder(nn.Module):
                     f"num_experts {self.num_experts} not divisible by "
                     f"expert axis {ep}")
 
-        def run_layers(p, h, tp_ax=None, moe_over=None):
-            """(h, aux_sum) over this param stack's layers. ``moe_over``
-            overrides the static moe triple — callers OUTSIDE a shard_map
-            (init fallback) must clear the expert axis name, which is only
-            bound inside the mapped body."""
-            mo = moe_over if moe_over is not None else moe_static
+        def run_layers(p, h, tp_ax=None, mapped=True):
+            """(h, aux_sum) over this param stack's layers. ``mapped=False``
+            is for callers OUTSIDE the shard_map (sequential path, init
+            fallback): the expert/seq axis names are only bound inside the
+            mapped body, so the moe triple drops its axis and ring
+            attention falls back to dense — mathematically identical over
+            the then-unsharded token dim, and parameter-free either way."""
+            mo = moe_static if mapped else moe_unmapped()
+            ai, sq = self.attention_impl, seq_static
+            if not mapped and ring:
+                ai, sq = "dense", None
             def step(hh, pp):
                 hh, aux = block_fn(pp, hh, self.num_heads, self.dtype,
-                                   tp_ax, self.attention_impl, mo)
+                                   tp_ax, ai, mo, sq)
                 return hh, aux
             h, auxs = lax.scan(step, h, p)
             return h, jnp.sum(auxs)
@@ -333,7 +381,7 @@ class PipelinedEncoder(nn.Module):
             # plain layer scan. The product only reaches PipelinedEncoder
             # with pipeline > 1 (VisionTransformer routes unpipelined MoE
             # through SwitchMlp), so no expert axis handling lives here.
-            y, aux = run_layers(params, x, moe_over=moe_unmapped())
+            y, aux = run_layers(params, x, mapped=False)
             return finish(y, aux)
         if local_b < m or local_b % m:
             # the shape-only init dummy may be too small to microbatch —
@@ -341,14 +389,14 @@ class PipelinedEncoder(nn.Module):
             # sequentially; a REAL batch in this state must fail loudly
             # (a silent sequential fallback would idle P-1 stages)
             if self.is_initializing():
-                return run_layers(params, x, moe_over=moe_unmapped())[0]
+                return run_layers(params, x, mapped=False)[0]
             raise ValueError(
                 f"local batch {local_b} (global {b} over {n_batch_shards} "
                 f"batch shards) must be a multiple of microbatches {m}")
 
         mesh = self.mesh
         from .transformer import _batch_axes
-        x_spec = P(_batch_axes(mesh) or None, None, None)
+        x_spec = P(_batch_axes(mesh) or None, "seq" if ring else None, None)
         # per-leaf specs MATCH param_sharding_rule's placement (pipeline on
         # the stacked depth axis, tensor on heads/hidden when tp is active)
         # so the shard_map consumes the training state's own shards with no
@@ -361,10 +409,13 @@ class PipelinedEncoder(nn.Module):
         def _aux_reduce(aux_acc):
             """Stage-local aux sums → one replicated scalar: sum stages,
             mean over microbatches (matching the unpipelined batch-level
-            scale) and over the batch shards."""
+            scale) and over the batch (and token, under seq sharding)
+            shards."""
             aux = lax.psum(aux_acc, "pipeline") / m
             for ax in (_batch_axes(mesh) or ()):
                 aux = lax.pmean(aux, ax)
+            if ring:
+                aux = lax.pmean(aux, "seq")
             return aux
 
         def pipelined(p_local, xg):

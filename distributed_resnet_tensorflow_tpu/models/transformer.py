@@ -196,19 +196,23 @@ class VisionTransformer(nn.Module):
             # GPipe microbatch pipeline over stacked-parameter stages
             # (models/pipeline.py); parameterization differs from the
             # per-block modules (pack_encoder_params converts).
-            # Attention inside a stage: dense, or the fused Pallas flash
-            # kernel (round 4) — 'auto' applies the same trace-time rule as
-            # the unpipelined path (flash on TPU past the measured
-            # crossover, docs/flash_tune_r3.json; the pipeline's
-            # per-microbatch token count is the full t). ring/blockwise
-            # stay rejected (no seq axis inside a stage).
+            # Attention inside a stage: dense, the fused Pallas flash
+            # kernel (round 4), or — with a seq axis — ring attention over
+            # the token sharding (round 5, pp×seq). 'auto' applies the
+            # same trace-time rules as the unpipelined path: ring when a
+            # seq axis exists, else flash on TPU past the measured
+            # crossover (docs/flash_tune_r3.json; the pipeline's
+            # per-microbatch token count is the full t).
             impl = self.attention_impl
             if impl == "auto":
-                impl = flash_or_dense(t)
-            if impl not in ("dense", "flash", "flash_interpret"):
+                impl = "ring" if seq > 1 else flash_or_dense(t)
+            allowed = ("ring", "ring_interpret") if seq > 1 else \
+                ("dense", "flash", "flash_interpret")
+            if impl not in allowed:
                 raise ValueError(
-                    "pipeline parallelism supports dense/flash attention "
-                    f"(got attention_impl={self.attention_impl!r})")
+                    f"pipeline parallelism with seq axis {seq} supports "
+                    f"attention_impl in {allowed} "
+                    f"(got {self.attention_impl!r})")
             from .pipeline import PipelinedEncoder
             x = PipelinedEncoder(depth=self.depth, num_heads=self.num_heads,
                                  mlp_ratio=self.mlp_ratio, dtype=self.dtype,

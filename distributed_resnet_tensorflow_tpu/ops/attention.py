@@ -153,6 +153,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return (acc / l.transpose(0, 2, 1)[..., None]).astype(v.dtype)
 
 
+def resolve_ring_kernel(kernel: str) -> str:
+    """The ONE auto rule for the ring inner block: the fused Pallas flash
+    kernels on TPU (measured 1.5×-3.6× the lax ring at 8k-32k tokens,
+    docs/ring_attention_r4.json), the pure-lax online recurrence elsewhere.
+    Shared by ring_attention_sharded and the pipelined stage blocks
+    (models/pipeline.py) so the two paths cannot drift."""
+    if kernel not in ("auto", "lax", "flash", "flash_interpret"):
+        raise ValueError(f"unknown ring attention kernel {kernel!r}")
+    if kernel == "auto":
+        return "flash" if jax.default_backend() == "tpu" else "lax"
+    return kernel
+
+
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, causal: bool = False,
                            seq_axis: str = "seq",
@@ -174,11 +187,7 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     from ..parallel.mesh import shard_map_compat
 
     n = mesh.shape[seq_axis]
-    if kernel not in ("auto", "lax", "flash", "flash_interpret"):
-        raise ValueError(f"unknown ring attention kernel {kernel!r}")
-    mode = kernel
-    if mode == "auto":
-        mode = "flash" if jax.default_backend() == "tpu" else "lax"
+    mode = resolve_ring_kernel(kernel)
 
     spec = P(batch_axes or None, seq_axis, None, None)
     if mode == "lax":

@@ -28,6 +28,18 @@ def stacked_encoder_spec(leaf_name: str, ndim: int, tensor: int = 1) -> P:
     sharding (param_sharding_rule) and the pipeline shard_map in_specs
     (models/pipeline.py) — they must agree or every step reshards."""
     if leaf_name.startswith("moe_"):
+        if tensor > 1:
+            # Megatron INSIDE each expert (MoE×tensor, round 5): columns
+            # of moe_w1 (L,E,D,F)/moe_bias1 (L,E,F), rows of moe_w2
+            # (L,E,F,D); moe_bias2 stays replicated across `tensor`
+            # (added after the completing psum, models/moe.expert_ffn)
+            spec = {
+                "moe_w1": P("pipeline", "expert", None, "tensor"),
+                "moe_bias1": P("pipeline", "expert", "tensor"),
+                "moe_w2": P("pipeline", "expert", "tensor", None),
+            }.get(leaf_name)
+            if spec is not None:
+                return spec
         return P(*(("pipeline", "expert") + (None,) * (ndim - 2)))
     if tensor > 1:
         spec = {
@@ -69,18 +81,30 @@ def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
         leaf = path.rsplit("['", 1)[-1].rstrip("]'")
         spec = stacked_encoder_spec(leaf, len(shape),
                                     mesh.shape.get("tensor", 1))
-        # only honor a tensor split the shape actually divides
+        # only honor a tensor split the shape actually divides (dropping
+        # back to the tensor-free spec keeps `expert` on MoE leaves)
         for axis_name, dim in zip(spec, shape):
             if axis_name == "tensor" and dim % mesh.shape["tensor"]:
-                return P(*(("pipeline",) + (None,) * (len(shape) - 1)))
+                return stacked_encoder_spec(leaf, len(shape), 1)
         return spec
     expert = mesh.shape.get("expert", 1)
-    if expert > 1 and "SwitchMlp" in path and "router" not in path \
-            and shape and shape[0] % expert == 0:
-        # Switch MoE expert-stacked weights: each expert group holds its
-        # own experts (+ moments); the router stays replicated
-        return P(*(("expert",) + (None,) * (len(shape) - 1)))
     tensor = mesh.shape.get("tensor", 1)
+    if "SwitchMlp" in path and "router" not in path and shape:
+        # Switch MoE expert-stacked weights: each expert group holds its
+        # own experts (+ moments); the router stays replicated. With a
+        # tensor axis, each expert's FFN additionally splits Megatron-
+        # style (w1/bias1 columns, w2 rows; one psum — expert_ffn), so
+        # ep×tp and tp-only MoE stop replicating the dominant FLOPs.
+        e_ax = "expert" if (expert > 1 and shape[0] % expert == 0) else None
+        leaf = path.rsplit("['", 1)[-1].rstrip("]'")
+        t_pos = {"w1": 2, "bias1": 1, "w2": 1}.get(leaf)
+        spec = [e_ax] + [None] * (len(shape) - 1)
+        if tensor > 1 and t_pos is not None and len(shape) > t_pos \
+                and shape[t_pos] % tensor == 0:
+            spec[t_pos] = "tensor"
+        if any(spec):
+            return P(*spec)
+        # no expert/tensor split applies — fall through to the fsdp rule
     if tensor > 1 and ("EncoderBlock" in path or "MultiHeadAttention" in path):
         if "kernel" in path:
             if "qkv" in path and len(shape) == 4 and shape[2] % tensor == 0:
@@ -170,24 +194,32 @@ def shard_stacked_batch(batch: Any, mesh: Mesh) -> Any:
 
 def make_global_stacked_batch(local_batch: Any, mesh: Mesh) -> Any:
     """Multi-process variant of shard_stacked_batch: each process holds
-    (K, B_local, ...); the global array is (K, B_local·nproc, ...)."""
-    from .mesh import data_sharding
+    (K, B_local, ...); the global array is (K, B_local·num_input_shards,
+    ...). The multiplier is the number of DISTINCT batch slices across
+    processes (mesh.process_batch_slice) — equal to process_count for pure
+    data-over-processes, smaller when a non-batch axis spans processes
+    (those processes feed identical replicated slices)."""
+    from .mesh import data_sharding, process_batch_slice
     sharding = NamedSharding(mesh, P(None, *data_sharding(mesh).spec))
+    _, n_shards = process_batch_slice(mesh)
 
     def _make(x):
-        global_shape = (x.shape[0], x.shape[1] * jax.process_count()) + x.shape[2:]
+        global_shape = (x.shape[0], x.shape[1] * n_shards) + x.shape[2:]
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
     return jax.tree_util.tree_map(_make, local_batch)
 
 
 def make_global_batch(local_batch: Any, mesh: Mesh) -> Any:
-    """Assemble a global jax.Array from per-process local data (multi-host)."""
-    from .mesh import data_sharding
+    """Assemble a global jax.Array from per-process local data (multi-host).
+    Global batch = local × num distinct batch slices (see
+    make_global_stacked_batch)."""
+    from .mesh import data_sharding, process_batch_slice
     sharding = data_sharding(mesh)
+    _, n_shards = process_batch_slice(mesh)
 
     def _make(x):
-        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        global_shape = (x.shape[0] * n_shards,) + x.shape[1:]
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
     return jax.tree_util.tree_map(_make, local_batch)

@@ -264,23 +264,17 @@ class Trainer:
                     raise ValueError(
                         f"vit_num_experts={cfg.model.vit_num_experts} not "
                         f"divisible by the expert axis ({n_exp_axis})")
-            if cfg.model.vit_num_experts > 0 and \
-                    self.mesh.shape.get("tensor", 1) > 1:
-                # no sharding rule splits expert MLPs over `tensor`; the
-                # dominant FLOPs would silently replicate on every chip
-                raise ValueError(
-                    "MoE blocks do not compose with tensor parallelism "
-                    "yet; shard experts over mesh.expert instead")
-            if self.mesh.shape.get("pipeline", 1) > 1:
-                # pp composes with dp/fsdp (microbatch over local batch),
-                # tp (Megatron psums inside each stage) and ep (stacked-
-                # stage Switch MoE, models/pipeline.py _moe_mlp; note
-                # ep×tp is already excluded by the blanket MoE×tensor
-                # rejection above); seq has no stacked-stage implementation
-                if self.mesh.shape.get("seq", 1) > 1:
-                    raise ValueError(
-                        "pipeline parallelism does not compose with "
-                        "'seq' yet; use pipeline x data x {tensor|expert}")
+            # MoE×tensor composes since round 5: expert FFNs are
+            # Megatron-split over `tensor` (parallel/sharding.py SwitchMlp
+            # rule, stacked_encoder_spec moe leaves, expert_ffn psum), so
+            # ep×tp and pp×ep×tp shard rather than replicate the expert
+            # FLOPs. Indivisible hidden dims degrade to replicated weights
+            # (the sharding rules check divisibility leaf-by-leaf).
+            # pp composes with dp/fsdp (microbatch over local batch), tp
+            # (Megatron psums inside each stage), ep (stacked-stage Switch
+            # MoE, models/pipeline.py _moe_mlp) and, since round 5, seq
+            # (ring attention inside the stage blocks) — no remaining
+            # pairwise rejection on the pipeline axis.
         self.model = create_model(cfg.model, cfg.data.dataset,
                                   remat=cfg.train.remat, bn_groups=bn_groups,
                                   mesh=self.mesh)

@@ -86,6 +86,7 @@ def _small_vit(impl, mesh=None):
                              attention_impl=impl, mesh=mesh)
 
 
+@pytest.mark.heavy
 def test_vit_ring_matches_dense_full_model():
     """Sequence parallelism as a MODEL feature: ring attention + seq-sharded
     tokens through the full ViT must reproduce the dense model's logits AND
