@@ -22,17 +22,23 @@ from .utils.metrics import MetricsWriter
 log = logging.getLogger(__name__)
 
 
-def make_eval_iterator(cfg):
-    """Fresh eval iterator, sharded per process so multi-host evaluation does
-    one global pass (each process contributes a disjoint slice of each global
-    batch instead of every process re-reading the full set)."""
+def make_eval_iterator(cfg, mesh=None):
+    """Fresh eval iterator, sharded per BATCH slice so multi-host evaluation
+    does one global pass (each distinct batch slice reads a disjoint set of
+    files; processes replicating a slice — e.g. pipeline stages — read the
+    same one). Without a mesh, falls back to process-index sharding (pure
+    data-over-processes, where the two are identical)."""
     import jax
 
     from .data import create_input_iterator
-    nproc = jax.process_count()
+    if mesh is not None:
+        from .parallel.mesh import process_batch_slice
+        shard_index, num_shards = process_batch_slice(mesh)
+    else:
+        shard_index, num_shards = jax.process_index(), jax.process_count()
     return create_input_iterator(
-        cfg, mode="eval", shard_index=jax.process_index(), num_shards=nproc,
-        batch_size=max(1, cfg.data.eval_batch_size // nproc))
+        cfg, mode="eval", shard_index=shard_index, num_shards=num_shards,
+        batch_size=max(1, cfg.data.eval_batch_size // num_shards))
 
 
 class Evaluator:
@@ -56,7 +62,7 @@ class Evaluator:
     def _iter(self) -> Iterator:
         if self._data_iter is not None:
             return self._data_iter
-        return make_eval_iterator(self.cfg)
+        return make_eval_iterator(self.cfg, self.trainer.mesh)
 
     def evaluate_checkpoint(self, step: int) -> Dict[str, float]:
         """Restore a specific checkpoint + run eval_batch_count batches

@@ -38,12 +38,12 @@ log = logging.getLogger(__name__)
 
 
 def _per_process_batch(global_bs: int, nproc: int) -> int:
-    """Global batch must divide evenly across processes — a silent floor
+    """Global batch must divide evenly across input shards — a silent floor
     would train a different effective batch than configured."""
     if global_bs % nproc:
         raise ValueError(
             f"train.batch_size={global_bs} is not divisible by "
-            f"process_count={nproc}; the global batch would silently shrink")
+            f"{nproc} input shards; the global batch would silently shrink")
     return global_bs // nproc
 
 
@@ -63,11 +63,16 @@ def _make_train_source(cfg: ExperimentConfig, trainer: Trainer):
         log.info("device-resident dataset: %d examples in HBM", len(labels))
         return epoch_index_iterator(len(labels), cfg.train.batch_size,
                                     cfg.train.seed)
-    nproc = jax.process_count()
+    # input shards are keyed by the process's BATCH slice, not its index:
+    # when a non-batch mesh axis (pipeline/tensor/...) spans processes,
+    # replica processes must feed identical data (parallel/mesh.py
+    # process_batch_slice)
+    from .parallel.mesh import process_batch_slice
+    shard_index, num_shards = process_batch_slice(trainer.mesh)
     return create_input_iterator(
-        cfg, mode="train", shard_index=jax.process_index(),
-        num_shards=nproc,
-        batch_size=_per_process_batch(cfg.train.batch_size, nproc))
+        cfg, mode="train", shard_index=shard_index,
+        num_shards=num_shards,
+        batch_size=_per_process_batch(cfg.train.batch_size, num_shards))
 
 
 def _peek(data_iter):
@@ -195,7 +200,7 @@ def run_train_and_eval(cfg: ExperimentConfig):
                                  hooks=tuple(hooks), start_step=step)
         step = int(state.step)
         # fresh iterator per round: the ImageNet eval stream is one-pass
-        result = trainer.evaluate(make_eval_iterator(cfg),
+        result = trainer.evaluate(make_eval_iterator(cfg, trainer.mesh),
                                   cfg.eval.eval_batch_count)
         best = max(best, result["precision"])
         if writer:

@@ -133,6 +133,38 @@ def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
         return shard_map(fn, check_rep=False, **kwargs)
 
 
+def process_batch_slice(mesh: Mesh) -> Tuple[int, int]:
+    """(input_shard_index, num_input_shards) for THIS process.
+
+    Multi-process input feeding must be keyed by which slice of the BATCH
+    dimension (the data × fsdp coordinate range) this process's devices
+    address — NOT by process_index. When a non-batch axis (pipeline,
+    tensor, expert, seq) crosses the process boundary, several processes
+    address the SAME batch slice and must feed identical data; sharding
+    input by process_index there desynchronizes the replicas (caught by
+    tests/test_launch.py::test_two_process_pipeline_vit_checkpoint_eval).
+    Pure data-over-processes reduces to (process_index, process_count).
+    """
+    pi = jax.process_index()
+    arr = mesh.devices
+    ax = {name: i for i, name in enumerate(mesh.axis_names)}
+    fsdp_size = mesh.shape.get("fsdp", 1)
+    ids = set()
+    for idx in np.ndindex(arr.shape):
+        if arr[idx].process_index == pi:
+            d = idx[ax["data"]] if "data" in ax else 0
+            f = idx[ax["fsdp"]] if "fsdp" in ax else 0
+            ids.add(d * fsdp_size + f)
+    total = mesh.shape.get("data", 1) * fsdp_size
+    lo, n = min(ids), len(ids)
+    if sorted(ids) != list(range(lo, lo + n)) or total % n or lo % n:
+        raise ValueError(
+            f"process {pi}'s devices cover batch shards {sorted(ids)} — "
+            "not an aligned contiguous range; choose mesh axis sizes so "
+            "each process's batch slice is contiguous")
+    return lo // n, total // n
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     n = batch_shard_count(mesh)
     if global_batch % n != 0:
