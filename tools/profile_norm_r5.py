@@ -1,0 +1,39 @@
+"""Round-5 normalization-contract sweep: ImageNet RN50 single-chip MFU under
+every norm contract (batch | frozen | group) at bs 32/128 — the measurement
+VERDICT r4 #1 demanded to settle the >=55%-MFU north star (BASELINE.md:30-32).
+Writes docs/perf_norm_r5.json. Shares bench.py's _bench_imagenet_at harness
+so the numbers are directly comparable with BENCH_r0N rows."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import bench  # noqa: E402
+
+
+def main():
+    out = {"device": jax.devices()[0].device_kind,
+           "workload": "imagenet_resnet50 synthetic, fused k=8 dispatch"}
+    for norm in ("batch", "frozen", "group"):
+        for bs, loops in ((32, 20), (128, 5)):
+            key = f"{norm}_bs{bs}"
+            t0 = time.time()
+            try:
+                row = bench._bench_imagenet_at(bs, loops=loops, norm=norm)
+                row["measure_secs"] = round(time.time() - t0, 1)
+                out[key] = row
+            except Exception as e:
+                out[key] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            print(key, json.dumps(out[key]), flush=True)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "perf_norm_r5.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()
