@@ -105,6 +105,7 @@ def seq_mesh4():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.heavy
 def test_ring_flash_matches_dense(seq_mesh4, causal):
     """The Pallas-inner ring (flash kernel per step + lse combine,
     interpret mode on CPU) == dense attention, fwd AND grads, causal and
@@ -133,6 +134,7 @@ def test_ring_flash_matches_dense(seq_mesh4, causal):
                                    rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.heavy
 def test_ring_flash_matches_lax_ring(seq_mesh4):
     """Same ring topology, two inner blocks: the flash-kernel ring and the
     pure-lax ring agree (they share nothing but the math)."""

@@ -78,6 +78,7 @@ def _write_fake_cifar10(tmp_path, n_per_file=20):
     return str(tmp_path)
 
 
+@pytest.mark.heavy
 def test_raw_iterator_and_device_augment_train_step(tmp_path):
     """End-to-end: device_augment=on makes the iterator yield raw uint8 and
     the Trainer augment + standardize inside the jitted step."""

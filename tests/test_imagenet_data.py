@@ -53,6 +53,7 @@ def test_example_roundtrip():
     assert np.allclose(parsed["image/object/bbox/xmin"], [0.1, 0.5], atol=1e-6)
 
 
+@pytest.mark.heavy
 def test_example_parse_real_tf_serialization():
     """Cross-check our wire parser against TensorFlow's own serializer."""
     tf = pytest.importorskip("tensorflow")
@@ -213,6 +214,7 @@ def test_imagenet_iterator_uint8_device_standardize(tmp_path):
     assert next(it_ev)["images"].dtype == np.uint8
 
 
+@pytest.mark.heavy
 def test_eval_uint8_metrics_match(tmp_path):
     """A full eval pass over the uint8 (device-standardize) iterator with
     the prep-hooked eval step == the host-float pass bit-for-bit on

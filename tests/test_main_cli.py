@@ -21,6 +21,7 @@ def _args(tmp_path, *extra):
             *extra]
 
 
+@pytest.mark.heavy
 def test_main_train_mode(tmp_path, capsys):
     main_mod.main(_args(
         tmp_path,
@@ -36,6 +37,7 @@ def test_main_train_mode(tmp_path, capsys):
     assert os.path.exists(os.path.join(tmp_path, "train", "metrics.jsonl"))
 
 
+@pytest.mark.heavy
 def test_main_train_and_eval_mode(tmp_path, capsys):
     main_mod.main(_args(
         tmp_path,
@@ -50,6 +52,7 @@ def test_main_train_and_eval_mode(tmp_path, capsys):
     assert "eval @ step 2" in out and "eval @ step 4" in out
 
 
+@pytest.mark.heavy
 def test_main_eval_once_mode(tmp_path):
     # first train + checkpoint...
     main_mod.main(_args(

@@ -44,6 +44,7 @@ def test_cifar_resnet20_param_count():
     assert 0.25e6 < n < 0.30e6, n
 
 
+@pytest.mark.heavy
 def test_wide_resnet_28_10_param_count():
     """WRN-28-10 ≈ 36.5M params — exercises the width generalization
     (BASELINE.json config 4)."""
@@ -56,6 +57,7 @@ def test_wide_resnet_28_10_param_count():
 
 
 @pytest.mark.parametrize("size", [18, 50])
+@pytest.mark.heavy
 def test_imagenet_resnet_shapes(size):
     model = ImageNetResNetV2(resnet_size=size, num_classes=1001,
                              dtype=jnp.float32)

@@ -97,6 +97,7 @@ def test_sharded_step_matches_single_device(mesh8):
                                    rtol=1e-3, atol=5e-4)
 
 
+@pytest.mark.heavy
 def test_fsdp_state_sharding(mesh_dp_fsdp):
     """Params/opt state shard over fsdp (ZeRO) — the capability replacing
     ps-side variable placement (reference resnet_cifar_main.py:392-396)."""

@@ -191,6 +191,7 @@ def test_evaluate():
     assert 0.0 <= out["precision"] <= 1.0
 
 
+@pytest.mark.heavy
 def test_lars_optimizer_runs():
     cfg = _tiny_cfg()
     cfg.optimizer.name = "lars"
@@ -260,6 +261,7 @@ def test_steps_per_loop_matches_sequential():
     assert np.isclose(float(m_seq["loss"]), float(m_fused["loss"]), rtol=1e-5)
 
 
+@pytest.mark.heavy
 def test_trainer_train_with_steps_per_loop_and_tail():
     """num_steps not a multiple of steps_per_loop: tail runs unfused."""
     cfg = _tiny_cfg()
@@ -407,6 +409,7 @@ def test_loss_decreases_with_group_norm():
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, losses
 
 
+@pytest.mark.heavy
 def test_loss_decreases_with_frozen_bn():
     """The frozen-BN fine-tune contract also trains from scratch (stats
     pinned at init 0/1 — a learned affine)."""
