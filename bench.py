@@ -373,30 +373,28 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     return out
 
 
-def _bench_imagenet_at(bs: int, k: int = 8, loops: int = 5,
-                       norm: str = "batch"):
-    """One ImageNet RN50 row at per-chip batch ``bs``, fused k-step
-    dispatch. ``norm`` selects the normalization contract
-    (batch | frozen | group — models/resnet.py)."""
+def _mfu_row(cfg, bs: int, image_size: int, num_classes: int,
+             k: int, loops: int):
+    """The ONE preset→Trainer→warmup→best-time→FLOPs→MFU measurement
+    harness (synthetic batches, fused k-step dispatch) behind every
+    single-chip MFU row — _bench_imagenet_at and bench_wrn28_10 share it
+    so timing/accounting fixes land once."""
     from distributed_resnet_tensorflow_tpu.parallel.sharding import (
         shard_batch, shard_stacked_batch)
     from distributed_resnet_tensorflow_tpu.train import Trainer
     from distributed_resnet_tensorflow_tpu.utils import profiling
-    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
 
-    cfg = get_preset("imagenet_resnet50")
-    cfg.data.dataset = "imagenet"
     cfg.train.batch_size = bs
     cfg.train.steps_per_loop = k
-    cfg.model.norm = norm
     cfg.mesh.data = len(jax.devices())
     trainer = Trainer(cfg)
     trainer.init_state()
     multi_fn = trainer.jitted_multi_step(k)
     rng = np.random.RandomState(0)
     batch = shard_stacked_batch({
-        "images": rng.randn(k, bs, 224, 224, 3).astype(np.float32),
-        "labels": rng.randint(0, 1001, (k, bs)).astype(np.int32),
+        "images": rng.randn(k, bs, image_size, image_size, 3)
+        .astype(np.float32),
+        "labels": rng.randint(0, num_classes, (k, bs)).astype(np.int32),
     }, trainer.mesh)
     state = trainer.state
     for _ in range(2):
@@ -418,6 +416,18 @@ def _bench_imagenet_at(bs: int, k: int = 8, loops: int = 5,
         "mfu": round(util, 4) if util else None,
         "step_flops": step_flops,
     }
+
+
+def _bench_imagenet_at(bs: int, k: int = 8, loops: int = 5,
+                       norm: str = "batch"):
+    """One ImageNet RN50 row at per-chip batch ``bs``, fused k-step
+    dispatch. ``norm`` selects the normalization contract
+    (batch | frozen | group — models/resnet.py)."""
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+    cfg = get_preset("imagenet_resnet50")
+    cfg.data.dataset = "imagenet"
+    cfg.model.norm = norm
+    return _mfu_row(cfg, bs, 224, 1001, k, loops)
 
 
 def bench_imagenet():
@@ -445,6 +455,21 @@ def bench_imagenet():
     except Exception as e:
         out["bs32"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     return out
+
+
+def bench_wrn28_10(k: int = 20, loops: int = 5):
+    """WRN-28-10 (shipped preset cifar100_wrn28_10) single-chip MFU — the
+    measured >=0.5-MFU conv training contract (BASELINE.md round-5
+    renegotiation; docs/perf_cifar_r5.md width lever: same code as the
+    0.17-MFU narrow-channel flagship, channels 160-640 fill the MXU)."""
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+    # keep the preset's cifar100 dataset so the device-side augmentation
+    # runs inside the timed step, exactly like the headline CIFAR row and
+    # the docs/perf_cifar_r5.json artifact (dataset='synthetic' would turn
+    # the augment ops off and time a different step)
+    cfg = get_preset("cifar100_wrn28_10")
+    cfg.data.data_dir = _synth_cifar_files()
+    return _mfu_row(cfg, 128, 32, 100, k, loops)
 
 
 def bench_imagenet_norm(budget_left):
@@ -552,6 +577,7 @@ def main():
     for key, fn in (("imagenet_resnet50", bench_imagenet),
                     ("flash_attention_causal", bench_flash_attention),
                     ("imagenet_input", lambda: bench_imagenet_input(budget_left)),
+                    ("cifar100_wrn28_10", bench_wrn28_10),
                     ("imagenet_norm_contracts",
                      lambda: bench_imagenet_norm(budget_left))):
         if time.monotonic() - t0 > budget:

@@ -92,3 +92,12 @@ def test_replay_reference_smoke(tmp_path, monkeypatch):
     assert report["eval_images"] == 200  # the FULL synthetic test split
     assert 0.0 <= report["top1"] <= 1.0
     assert os.path.exists(str(tmp_path / "replay" / "replay_report.md"))
+
+
+def test_main_mode_dispatch_fast():
+    """Quick-tier coverage of the main.py entry (the mode-specific paths
+    are heavy-tier): arg parsing + config wiring + the mode dispatch
+    rejection, no training compiled."""
+    from distributed_resnet_tensorflow_tpu import main as main_mod
+    with pytest.raises(ValueError, match="unknown mode"):
+        main_mod.main(["--preset", "smoke", "--set", "mode=bogus"])
