@@ -466,9 +466,9 @@ def bench_wrn28_10(k: int = 20, loops: int = 5):
     # keep the preset's cifar100 dataset so the device-side augmentation
     # runs inside the timed step, exactly like the headline CIFAR row and
     # the docs/perf_cifar_r5.json artifact (dataset='synthetic' would turn
-    # the augment ops off and time a different step)
+    # the augment ops off and time a different step); batches are synthetic
+    # so no data_dir is needed
     cfg = get_preset("cifar100_wrn28_10")
-    cfg.data.data_dir = _synth_cifar_files()
     return _mfu_row(cfg, 128, 32, 100, k, loops)
 
 
