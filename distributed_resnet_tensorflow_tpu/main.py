@@ -101,10 +101,63 @@ def _write_input_grid(writer: MetricsWriter, batch, trainer: Trainer) -> None:
         writer.write_images(1, "inputs", np.asarray(images)[:8])
 
 
+def _check_resume_config(cfg: ExperimentConfig) -> None:
+    """Record this run's config next to the checkpoints and WARN loudly
+    when resuming under a different training recipe.
+
+    Shape-identical configs (e.g. the gbs=128 and gbs=512 CIFAR presets)
+    restore into each other without any error, silently entering the new
+    LR schedule mid-stream — the reference had the same hazard via
+    MonitoredTrainingSession. A changed recipe can be deliberate
+    (fine-tuning), so this warns rather than refuses; the snapshot then
+    reflects the NEW recipe."""
+    import json as _json
+    ckpt_dir = resolve_checkpoint_dir(cfg)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, "config.json")
+    now = cfg.to_dict()
+    if cfg.checkpoint.resume and os.path.exists(path):
+        try:
+            with open(path) as f:
+                saved = _json.load(f)
+        except Exception:
+            saved = None
+        if saved:
+            # benign continuation knobs — changing them is the normal way
+            # to extend/observe a run, not a recipe change
+            benign = {("train", "train_steps"), ("train", "log_every_steps"),
+                      ("train", "summary_every_steps"),
+                      ("train", "eval_every_steps"),
+                      ("train", "steps_per_loop"), ("train", "scan_unroll"),
+                      ("train", "log_mfu")}
+
+            def norm(v):
+                return list(v) if isinstance(v, (tuple, list)) else v
+
+            diffs = []
+            for section in ("optimizer", "train", "model", "data"):
+                for key, val in now.get(section, {}).items():
+                    if (section, key) in benign:
+                        continue
+                    old = saved.get(section, {}).get(key, val)
+                    if norm(old) != norm(val):
+                        diffs.append(f"{section}.{key}: {old} -> {val}")
+            if diffs:
+                log.warning(
+                    "resuming %s under a DIFFERENT config than it was "
+                    "trained with: %s — if this is not a deliberate "
+                    "fine-tune/schedule change, point log_root elsewhere",
+                    ckpt_dir, "; ".join(diffs))
+    if is_chief():
+        with open(path, "w") as f:
+            _json.dump(now, f, indent=1, sort_keys=True)
+
+
 def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     """Build → (maybe) restore → train with hooks. Returns (state, metrics)."""
     trainer = Trainer(cfg)
     trainer.init_state()
+    _check_resume_config(cfg)
 
     manager = CheckpointManager(
         resolve_checkpoint_dir(cfg), max_to_keep=cfg.checkpoint.max_to_keep,
@@ -170,6 +223,7 @@ def run_train_and_eval(cfg: ExperimentConfig):
     processes with mode=train / mode=eval)."""
     trainer = Trainer(cfg)
     trainer.init_state()
+    _check_resume_config(cfg)
     manager = CheckpointManager(
         resolve_checkpoint_dir(cfg), max_to_keep=cfg.checkpoint.max_to_keep,
         save_every_steps=cfg.checkpoint.save_every_steps,

@@ -63,3 +63,20 @@ def test_parse_args():
     cfg = parse_args(["--preset", "smoke", "--set", "train.train_steps=5"])
     assert cfg.train.train_steps == 5
     assert cfg.data.dataset == "synthetic"
+
+
+def test_bs512_throughput_preset():
+    """The measured single-chip throughput optimum (docs/perf_cifar_r5.md)
+    as a preset: linear-scaled LR (x4) with the epoch budget of the
+    gbs=128 recipe (4x fewer steps, proportional boundaries)."""
+    cfg = get_preset("cifar10_resnet50_bs512")
+    base = get_preset("cifar10_resnet50")
+    assert cfg.train.batch_size == 4 * base.train.batch_size
+    assert cfg.train.train_steps * 4 == base.train.train_steps
+    assert cfg.optimizer.values[0] == 4 * base.optimizer.values[0]
+    assert len(cfg.optimizer.boundaries) == len(base.optimizer.boundaries)
+    assert all(4 * b == bb for b, bb in
+               zip(cfg.optimizer.boundaries, base.optimizer.boundaries))
+    # epoch budget preserved: steps x batch equal
+    assert cfg.train.train_steps * cfg.train.batch_size == \
+        base.train.train_steps * base.train.batch_size

@@ -101,3 +101,34 @@ def test_main_mode_dispatch_fast():
     from distributed_resnet_tensorflow_tpu import main as main_mod
     with pytest.raises(ValueError, match="unknown mode"):
         main_mod.main(["--preset", "smoke", "--set", "mode=bogus"])
+
+
+@pytest.mark.heavy
+def test_resume_config_mismatch_warns(tmp_path, caplog):
+    """Resuming a checkpoint dir under a different training recipe warns
+    loudly (shape-identical configs restore silently otherwise — e.g. the
+    gbs=128 vs gbs=512 presets); benign continuation knobs (train_steps,
+    cadences) stay silent."""
+    import logging
+    from distributed_resnet_tensorflow_tpu.main import run_train
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    def cfg_for(steps, lr):
+        cfg = get_preset("smoke")
+        cfg.log_root = str(tmp_path)
+        cfg.train.train_steps = steps
+        cfg.train.batch_size = 16  # divisible over the 8-device test mesh
+        cfg.optimizer.learning_rate = lr
+        cfg.checkpoint.save_every_steps = 2
+        cfg.checkpoint.save_every_secs = 0.0
+        return cfg
+
+    run_train(cfg_for(2, 0.1))
+    with caplog.at_level(logging.WARNING):
+        run_train(cfg_for(4, 0.1))  # benign: just more steps
+    assert not [r for r in caplog.records
+                if "DIFFERENT config" in r.message]
+    with caplog.at_level(logging.WARNING):
+        run_train(cfg_for(6, 0.05))  # recipe change: lr
+    warns = [r for r in caplog.records if "DIFFERENT config" in r.message]
+    assert warns and "learning_rate" in warns[0].message
