@@ -39,7 +39,7 @@ OUT = os.path.join(REPO, "docs", "perf_vit_r5.json")
 
 
 def measure(attn: str, bs: int, k: int = 4, loops: int = 5, reps: int = 5,
-            remat=None):
+            remat=None, **overrides):
     from distributed_resnet_tensorflow_tpu.parallel.sharding import (
         shard_batch, shard_stacked_batch)
     from distributed_resnet_tensorflow_tpu.train import Trainer
@@ -52,6 +52,8 @@ def measure(attn: str, bs: int, k: int = 4, loops: int = 5, reps: int = 5,
     cfg.train.steps_per_loop = k
     if remat is not None:
         cfg.train.remat = remat
+    for dotted, v in overrides.items():
+        cfg.override(dotted.replace("__", "."), v)
     cfg.mesh.data = len(jax.devices())
     trainer = Trainer(cfg)
     trainer.init_state()
