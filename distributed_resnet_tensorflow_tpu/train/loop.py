@@ -532,6 +532,25 @@ class Trainer:
         """
         if self.state is None:
             self.init_state()
+        if self.cfg.model.norm == "group" \
+                and not getattr(self, "_gn_lr_warned", False):
+            # measured (docs/perf_norm_r5.md): GroupNorm starting at bare
+            # lr>=0.1 sits on a long optimization plateau with
+            # seed-dependent escape; a short warmup removes it. Probe the
+            # RESOLVED schedule at step 0 (raw config fields lie: piecewise
+            # ignores learning_rate, constant ignores warmup_steps). Warn
+            # once, at training time only (the evaluator builds a Trainer
+            # too), and don't refuse — small models are fine without it.
+            self._gn_lr_warned = True
+            if float(self.schedule(0)) > 0.05:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "model.norm='group' and the schedule starts at "
+                    "lr=%.3g (no effective warmup): GroupNorm measured a "
+                    "seed-dependent optimization plateau at bare high lr "
+                    "(docs/perf_norm_r5.md) — consider "
+                    "optimizer.schedule='warmup_piecewise' with ~500 "
+                    "warmup steps", float(self.schedule(0)))
         num_steps = num_steps or self.cfg.train.train_steps
         k = max(1, self.cfg.train.steps_per_loop)
         metrics = None

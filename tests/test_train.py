@@ -432,3 +432,41 @@ def test_loss_decreases_with_frozen_bn():
     after = jax.tree_util.tree_leaves(tr.state.batch_stats)
     for b, a in zip(before, after):
         np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+
+
+def test_group_norm_warmupless_high_lr_warns(caplog):
+    """The measured GroupNorm plateau (docs/perf_norm_r5.md) warns at
+    TRAIN time when the RESOLVED schedule starts high (probing the
+    schedule, not raw config fields — piecewise ignores learning_rate and
+    constant ignores warmup_steps); an effective warmup stays silent, and
+    merely constructing a Trainer (the evaluator does) never warns."""
+    import logging
+    from distributed_resnet_tensorflow_tpu.data import (
+        learnable_synthetic_iterator)
+    cfg = _tiny_cfg()
+    cfg.model.norm = "group"
+    # piecewise starting at 0.1 — learning_rate field deliberately low to
+    # prove the probe reads the schedule, not the raw field
+    cfg.optimizer.schedule = "piecewise"
+    cfg.optimizer.learning_rate = 0.001
+    cfg.optimizer.boundaries = (50,)
+    cfg.optimizer.values = (0.1, 0.01)
+    with caplog.at_level(logging.WARNING):
+        tr = Trainer(cfg)
+    assert not any("plateau" in r.message for r in caplog.records)
+    with caplog.at_level(logging.WARNING):
+        tr.train(learnable_synthetic_iterator(16, 8, 4), num_steps=1)
+    assert any("plateau" in r.message for r in caplog.records)
+    caplog.clear()
+    # effective warmup: schedule starts low -> silent
+    cfg2 = _tiny_cfg()
+    cfg2.model.norm = "group"
+    cfg2.optimizer.schedule = "warmup_piecewise"
+    cfg2.optimizer.warmup_steps = 500
+    cfg2.optimizer.warmup_start = 0.01
+    cfg2.optimizer.boundaries = (600,)
+    cfg2.optimizer.values = (0.1, 0.01)
+    tr2 = Trainer(cfg2)
+    with caplog.at_level(logging.WARNING):
+        tr2.train(learnable_synthetic_iterator(16, 8, 4), num_steps=1)
+    assert not any("plateau" in r.message for r in caplog.records)
