@@ -59,6 +59,21 @@ def test_json_roundtrip():
     assert cfg2.optimizer.boundaries == cfg.optimizer.boundaries
 
 
+def test_vit_large_224_preset():
+    """The transformer-family >=0.55-MFU contract (measured 0.57,
+    docs/perf_vit_classic_r5.md): ViT-L/16 shape, dense attention (196
+    tokens is far below the 2k flash crossover), per-chip batch pinned at
+    the measured optimum."""
+    cfg = get_preset("vit_large_224")
+    assert cfg.model.name == "vit"
+    assert (cfg.model.vit_dim, cfg.model.vit_depth,
+            cfg.model.vit_heads) == (1024, 24, 16)
+    assert cfg.data.image_size // cfg.model.vit_patch_size == 14  # 196 tokens
+    assert cfg.model.attention_impl == "dense"
+    assert cfg.train.batch_size == 32
+    assert not cfg.train.remat
+
+
 def test_parse_args():
     cfg = parse_args(["--preset", "smoke", "--set", "train.train_steps=5"])
     assert cfg.train.train_steps == 5
