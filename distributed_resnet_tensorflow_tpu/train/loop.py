@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh import (batch_shard_count, create_mesh, data_sharding,
                              present_batch_axes, shard_map_compat)
 from ..parallel.sharding import make_global_batch, shard_batch
-from .optimizers import create_optimizer, loss_weight_decay
+from .optimizers import (create_optimizer, decoupled_decay,
+                         loss_weight_decay)
 from .schedules import create_schedule
 from .state import TrainState, create_train_state, state_shardings
 
@@ -279,13 +280,14 @@ class Trainer:
                                   remat=cfg.train.remat, bn_groups=bn_groups,
                                   mesh=self.mesh)
         self.schedule = create_schedule(cfg.optimizer)
-        decay_in_loss = cfg.optimizer.name != "lars"
+        decay_in_loss = not decoupled_decay(cfg.optimizer.name)
         if cfg.optimizer.decay_all_params and not decay_in_loss:
-            # LARS takes decay inside the optimizer (non-BN mask); the
+            # LARS/AdamW take decay inside the optimizer (non-BN mask); the
             # reference-faithful all-params L2 only exists on the loss path
             raise ValueError(
                 "optimizer.decay_all_params is incompatible with "
-                "optimizer.name='lars' (LARS applies its own masked decay)")
+                f"optimizer.name={cfg.optimizer.name!r} (decoupled decay "
+                "is applied inside the optimizer)")
         self.tx = create_optimizer(cfg.optimizer, self.schedule)
         from ..data import device_augment_enabled
         aug_fn = None
@@ -337,7 +339,7 @@ class Trainer:
         return make_train_step(
             self.schedule, cfg.optimizer.weight_decay,
             cfg.optimizer.label_smoothing,
-            decay_in_loss=cfg.optimizer.name != "lars",
+            decay_in_loss=not decoupled_decay(cfg.optimizer.name),
             grad_accum_steps=cfg.train.grad_accum_steps,
             decay_all_params=cfg.optimizer.decay_all_params,
             ce_fn=make_ce_fn(cfg.optimizer.label_smoothing,

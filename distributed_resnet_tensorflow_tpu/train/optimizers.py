@@ -8,8 +8,11 @@ reference README.md:51-52).
 
 Weight decay is applied in the LOSS like the reference (resnet_model.py:78-86),
 not decoupled — except for LARS, which takes decay inside the optimizer per
-the LARS paper formulation. The decayed set differs by default: kernels-only
-(ndim>1, excluding BN γ/β and biases), with ``optimizer.decay_all_params``
+the LARS paper formulation, and AdamW, which is the decoupled-decay
+formulation by definition (the transformer-family presets use it: loss-side
+L2 under Adam's per-parameter scaling is neither the reference's semantics
+nor AdamW's). The decayed set differs by default: kernels-only (ndim>1,
+excluding BN γ/β and biases), with ``optimizer.decay_all_params``
 restoring the reference's all-trainables L2 for parity replays — see
 ``loss_weight_decay``.
 
@@ -37,6 +40,12 @@ def create_optimizer(opt_cfg, schedule: Callable) -> optax.GradientTransformatio
         chain.append(optax.sgd(schedule, momentum=opt_cfg.momentum))
     elif name == "adam":
         chain.append(optax.adam(schedule))
+    elif name == "adamw":
+        # decoupled decay (mask matches LARS: kernels only, no norm/bias);
+        # the train loop skips the loss-side L2 for this optimizer
+        chain.append(optax.adamw(
+            schedule, weight_decay=opt_cfg.weight_decay,
+            mask=_non_bn_mask))
     elif name == "lars":
         # optax.lars handles per-layer trust ratios; weight decay is part of
         # the LARS update (masked away from BN/bias by weight_decay_mask).
@@ -53,17 +62,28 @@ def create_optimizer(opt_cfg, schedule: Callable) -> optax.GradientTransformatio
     return optax.chain(*chain) if len(chain) > 1 else chain[0]
 
 
+def decoupled_decay(name: str) -> bool:
+    """True for optimizers that take weight decay INSIDE the update (LARS,
+    AdamW) — the train loop must then skip the loss-side L2, and
+    ``decay_all_params`` (a loss-path switch) is rejected. The single
+    predicate behind both decisions (train/loop.py)."""
+    return name in ("lars", "adamw")
+
+
 def _non_bn_mask(params):
     """True for params that should get weight decay / trust-ratio scaling:
-    exclude BatchNorm scale/bias and all 1-D params (biases)."""
+    exclude BatchNorm scale/bias, all 1-D params (biases), and position
+    embeddings (`pos_embed`, (1, T, D) — ndim>1 but not a matmul kernel;
+    ViT recipes conventionally exempt it from decay)."""
     import jax
 
     def keep(path, leaf):
         names = [str(p) for p in path]
         if any("BatchNorm" in n for n in names):
             return False
-        # expert-stacked MoE biases are 2-D; exclude biases by name too
-        if names and "bias" in names[-1]:
+        # expert-stacked MoE biases are 2-D; exclude biases (and the ViT
+        # pos_embed) by name too
+        if names and ("bias" in names[-1] or "pos_embed" in names[-1]):
             return False
         return leaf.ndim > 1
 
@@ -90,8 +110,12 @@ def loss_weight_decay(params, rate: float, all_params: bool = False):
 
     def kernel_like(path, leaf):
         # 2-D+ non-bias leaves; "bias" checked by name because
-        # expert-stacked MoE biases are 2-D (models/moe.py)
-        return leaf.ndim > 1 and "bias" not in str(path[-1])
+        # expert-stacked MoE biases are 2-D (models/moe.py). pos_embed is
+        # exempt like in _non_bn_mask so the loss-side and decoupled decay
+        # paths define the SAME default decayed set (kernels only)
+        name = str(path[-1])
+        return leaf.ndim > 1 and "bias" not in name \
+            and "pos_embed" not in name
 
     leaves = [leaf for path, leaf in
               jax.tree_util.tree_flatten_with_path(params)[0]

@@ -118,7 +118,7 @@ class OptimizerConfig:
     warmup+piecewise for ImageNet (resnet_imagenet_main.py:236-247).
     Adds LARS for large-batch (bs=32k) scaling."""
 
-    name: str = "momentum"            # sgd | momentum | adam | lars
+    name: str = "momentum"            # sgd | momentum | adam | adamw | lars
     momentum: float = 0.9
     learning_rate: float = 0.1
     weight_decay: float = 2e-4        # cifar train value (reference resnet_cifar_main.py:99); imagenet: 1e-4
@@ -393,7 +393,7 @@ def _vit_large_224() -> ExperimentConfig:
         vit_depth=24, vit_heads=16, attention_impl="dense")
     cfg.data = DataConfig(dataset="synthetic", image_size=224)
     cfg.optimizer = OptimizerConfig(
-        name="adam", learning_rate=3e-4, weight_decay=0.05,
+        name="adamw", learning_rate=3e-4, weight_decay=0.05,
         schedule="cosine", warmup_steps=10000, total_steps=300000)
     cfg.train = TrainConfig(batch_size=32, train_steps=300000,
                             steps_per_loop=8, remat=False)

@@ -4,6 +4,7 @@ gradient accumulation (covers the reference's train() drivers, SURVEY.md
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 import pytest
 
 from distributed_resnet_tensorflow_tpu.data import learnable_synthetic_iterator
@@ -203,6 +204,48 @@ def test_lars_optimizer_runs():
     it = learnable_synthetic_iterator(16, 8, 4)
     state, m = tr.train(it, num_steps=2)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_adamw_decoupled_decay():
+    """AdamW (the transformer-family presets' optimizer) takes decay inside
+    the optimizer: loss == cross_entropy even at wd > 0 (no loss-side L2),
+    yet a decayed kernel shrinks under zero gradients while masked params
+    (bias, pos_embed) do not."""
+    cfg = _tiny_cfg()
+    cfg.optimizer.name = "adamw"
+    cfg.optimizer.weight_decay = 0.1
+    tr = Trainer(cfg)
+    tr.init_state()
+    it = learnable_synthetic_iterator(16, 8, 4)
+    state, m = tr.train(it, num_steps=2)
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["loss"]) == pytest.approx(float(m["cross_entropy"]))
+
+    # the decay itself, isolated: zero gradients, one update — decayed
+    # kernels shrink by ~lr*wd, masked leaves (bias, pos_embed) are frozen
+    from distributed_resnet_tensorflow_tpu.train.optimizers import (
+        create_optimizer)
+    tx = create_optimizer(cfg.optimizer, lambda step: 0.01)
+    params = {"Dense_0": {"kernel": jnp.ones((4, 4)),
+                          "bias": jnp.ones((4,))},
+              "pos_embed": jnp.ones((1, 3, 4))}
+    opt_state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    updates, _ = tx.update(grads, opt_state, params)
+    new = optax.apply_updates(params, updates)
+    assert float(jnp.max(jnp.abs(new["Dense_0"]["kernel"]))) < 1.0
+    assert float(jnp.min(new["Dense_0"]["bias"])) == 1.0
+    assert float(jnp.min(new["pos_embed"])) == 1.0
+
+
+def test_adamw_rejects_decay_all_params():
+    """decay_all_params is the loss-side reference-parity switch; decoupled
+    optimizers must refuse it loudly rather than silently ignore it."""
+    cfg = _tiny_cfg()
+    cfg.optimizer.name = "adamw"
+    cfg.optimizer.decay_all_params = True
+    with pytest.raises(ValueError, match="decay_all_params"):
+        Trainer(cfg)
 
 
 def test_evaluate_with_masked_batches():
