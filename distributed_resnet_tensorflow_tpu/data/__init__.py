@@ -33,9 +33,16 @@ def device_augment_enabled(cfg, mode: str = "train") -> bool:
 
 
 def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
-                          num_shards: int = 1, batch_size=None):
+                          num_shards: int = 1, batch_size=None,
+                          deterministic: bool = False):
     """Input factory — the one definition replacing the 4 near-identical
-    ``input_fn`` copies in the reference mains (SURVEY.md §1 note)."""
+    ``input_fn`` copies in the reference mains (SURVEY.md §1 note).
+
+    ``deterministic``: required when several processes feed the SAME
+    replicated batch slice (non-batch mesh axis over processes) — the
+    imagenet pipeline's parallel decode is otherwise completion-ordered
+    (see imagenet_iterator). The synthetic and cifar paths are
+    deterministic by construction (seeded single-generator streams)."""
     d = cfg.data
     bs = batch_size or (cfg.train.batch_size if mode == "train"
                         else d.eval_batch_size)
@@ -59,5 +66,6 @@ def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
                                  use_native=d.use_native_loader,
                                  device_standardize=device_augment_enabled(
                                      cfg, mode),
-                                 decode_processes=d.decode_processes)
+                                 decode_processes=d.decode_processes,
+                                 deterministic=deterministic)
     raise ValueError(f"unknown dataset {d.dataset!r}")

@@ -32,13 +32,16 @@ def make_eval_iterator(cfg, mesh=None):
 
     from .data import create_input_iterator
     if mesh is not None:
-        from .parallel.mesh import process_batch_slice
+        from .parallel.mesh import batch_slice_replicated, process_batch_slice
         shard_index, num_shards = process_batch_slice(mesh)
+        replicated = batch_slice_replicated(mesh)
     else:
         shard_index, num_shards = jax.process_index(), jax.process_count()
+        replicated = False
     return create_input_iterator(
         cfg, mode="eval", shard_index=shard_index, num_shards=num_shards,
-        batch_size=max(1, cfg.data.eval_batch_size // num_shards))
+        batch_size=max(1, cfg.data.eval_batch_size // num_shards),
+        deterministic=replicated)
 
 
 class Evaluator:

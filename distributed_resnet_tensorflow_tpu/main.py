@@ -67,12 +67,13 @@ def _make_train_source(cfg: ExperimentConfig, trainer: Trainer):
     # when a non-batch mesh axis (pipeline/tensor/...) spans processes,
     # replica processes must feed identical data (parallel/mesh.py
     # process_batch_slice)
-    from .parallel.mesh import process_batch_slice
+    from .parallel.mesh import batch_slice_replicated, process_batch_slice
     shard_index, num_shards = process_batch_slice(trainer.mesh)
     return create_input_iterator(
         cfg, mode="train", shard_index=shard_index,
         num_shards=num_shards,
-        batch_size=_per_process_batch(cfg.train.batch_size, num_shards))
+        batch_size=_per_process_batch(cfg.train.batch_size, num_shards),
+        deterministic=batch_slice_replicated(trainer.mesh))
 
 
 def _peek(data_iter):

@@ -168,6 +168,11 @@ def expert_ffn(ein: jax.Array, w1, b1, w2, b2, dtype,
     return out + b2[:, None, :].astype(dtype)
 
 
+# (mode, num_experts) pairs already announced by the 'auto' resolution log
+# below — once per resolution, not per layer per retrace
+_AUTO_RESOLVED_LOGGED: set = set()
+
+
 class SwitchMlp(nn.Module):
     """Drop-in replacement for the EncoderBlock MLP: LN'd input in,
     residual-branch output out. Shapes: (B, T, D) → (B, T, D)."""
@@ -236,13 +241,19 @@ class SwitchMlp(nn.Module):
                 # trace time so users replaying pre-round-4 runs know to
                 # pin dispatch='einsum' (PARITY.md §2.10 records the
                 # change). Unsharded meshes keep the unchanged gather
-                # semantics — nothing to announce.
-                import logging
-                logging.getLogger(__name__).info(
-                    "SwitchMlp dispatch='auto' resolved to %r (mesh "
-                    "expert axis %d); pin model.vit_moe_dispatch to fix "
-                    "routing numerics across versions", mode,
-                    self.mesh.shape.get("expert", 1))
+                # semantics — nothing to announce. Once per resolution
+                # (not per layer per retrace): a depth-L model would
+                # otherwise drown the one-time numerics note in L
+                # identical lines every trace.
+                e_axis = self.mesh.shape.get("expert", 1)
+                log_key = (mode, self.num_experts, e_axis)
+                if log_key not in _AUTO_RESOLVED_LOGGED:
+                    _AUTO_RESOLVED_LOGGED.add(log_key)
+                    import logging
+                    logging.getLogger(__name__).info(
+                        "SwitchMlp dispatch='auto' resolved to %r (mesh "
+                        "expert axis %d); pin model.vit_moe_dispatch to fix "
+                        "routing numerics across versions", mode, e_axis)
         if mode not in ("einsum", "gather", "a2a"):
             raise ValueError(f"unknown moe dispatch mode {mode!r}")
 

@@ -25,6 +25,7 @@ be added without re-architecting — see SURVEY.md §5 "long-context" note):
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Sequence, Tuple
 
@@ -133,6 +134,7 @@ def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
         return shard_map(fn, check_rep=False, **kwargs)
 
 
+@functools.lru_cache(maxsize=32)
 def process_batch_slice(mesh: Mesh) -> Tuple[int, int]:
     """(input_shard_index, num_input_shards) for THIS process.
 
@@ -144,6 +146,12 @@ def process_batch_slice(mesh: Mesh) -> Tuple[int, int]:
     input by process_index there desynchronizes the replicas (caught by
     tests/test_launch.py::test_two_process_pipeline_vit_checkpoint_eval).
     Pure data-over-processes reduces to (process_index, process_count).
+
+    Cached per mesh (lru on the function itself, bounded): the result is
+    a pure function of the mesh, but the computation scans every device
+    coordinate (O(total devices) in Python) and the callers
+    (make_global_batch / make_global_stacked_batch) sit in the per-step
+    input hot path.
     """
     pi = jax.process_index()
     arr = mesh.devices
@@ -163,6 +171,16 @@ def process_batch_slice(mesh: Mesh) -> Tuple[int, int]:
             "not an aligned contiguous range; choose mesh axis sizes so "
             "each process's batch slice is contiguous")
     return lo // n, total // n
+
+
+def batch_slice_replicated(mesh: Mesh) -> bool:
+    """True when several processes feed the SAME batch slice (a non-batch
+    mesh axis spans the process boundary): fewer distinct slices than
+    processes. Replicas must then assemble byte-identical batches — input
+    builders pass this as the pipeline's ``deterministic`` flag
+    (data/imagenet.py)."""
+    _, num_shards = process_batch_slice(mesh)
+    return jax.process_count() > num_shards
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:

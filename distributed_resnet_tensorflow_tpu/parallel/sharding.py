@@ -54,6 +54,28 @@ def stacked_encoder_spec(leaf_name: str, ndim: int, tensor: int = 1) -> P:
     return P(*(("pipeline",) + (None,) * (ndim - 1)))
 
 
+# (leaf, shape, tensor) triples already warned about below — once per
+# distinct drop-back, not per retrace/model rebuild
+_TENSOR_DROPBACK_WARNED: set = set()
+
+
+def _warn_tensor_dropback(path: str, shape, tensor: int) -> None:
+    """A requested tensor split the shape does not divide falls back to
+    replication — numerics stay correct, but the leaf's FLOPs (often the
+    dominant MLP matmuls) then run in full on every tensor peer. Silent
+    replicated compute is the failure mode the Trainer's dead-axis config
+    checks exist to prevent, so say it loudly, once per leaf shape."""
+    key = (path.rsplit("['", 1)[-1], tuple(shape), tensor)
+    if key in _TENSOR_DROPBACK_WARNED:
+        return
+    _TENSOR_DROPBACK_WARNED.add(key)
+    import logging
+    logging.getLogger(__name__).warning(
+        "tensor axis (%d) does not divide the split dim of %s (shape %s) "
+        "— this leaf will REPLICATE across tensor peers; pick model dims "
+        "divisible by the tensor axis", tensor, path, tuple(shape))
+
+
 def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
                         fsdp_min_size: int = 2 ** 16) -> P:
     """Parameter placement rule.
@@ -85,6 +107,7 @@ def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
         # back to the tensor-free spec keeps `expert` on MoE leaves)
         for axis_name, dim in zip(spec, shape):
             if axis_name == "tensor" and dim % mesh.shape["tensor"]:
+                _warn_tensor_dropback(path, shape, mesh.shape["tensor"])
                 return stacked_encoder_spec(leaf, len(shape), 1)
         return spec
     expert = mesh.shape.get("expert", 1)
@@ -99,24 +122,29 @@ def param_sharding_rule(path: str, shape: tuple, mesh: Mesh,
         leaf = path.rsplit("['", 1)[-1].rstrip("]'")
         t_pos = {"w1": 2, "bias1": 1, "w2": 1}.get(leaf)
         spec = [e_ax] + [None] * (len(shape) - 1)
-        if tensor > 1 and t_pos is not None and len(shape) > t_pos \
-                and shape[t_pos] % tensor == 0:
-            spec[t_pos] = "tensor"
+        if tensor > 1 and t_pos is not None and len(shape) > t_pos:
+            if shape[t_pos] % tensor == 0:
+                spec[t_pos] = "tensor"
+            else:
+                _warn_tensor_dropback(path, shape, tensor)
         if any(spec):
             return P(*spec)
         # no expert/tensor split applies — fall through to the fsdp rule
     if tensor > 1 and ("EncoderBlock" in path or "MultiHeadAttention" in path):
         if "kernel" in path:
-            if "qkv" in path and len(shape) == 4 and shape[2] % tensor == 0:
-                return P(None, None, "tensor", None)
-            if "proj" in path and len(shape) == 3 and shape[0] % tensor == 0:
-                return P("tensor", None, None)
-            if "Dense_0" in path and len(shape) == 2 \
-                    and shape[1] % tensor == 0:
-                return P(None, "tensor")
-            if "Dense_1" in path and len(shape) == 2 \
-                    and shape[0] % tensor == 0:
-                return P("tensor", None)
+            split_dim = None
+            if "qkv" in path and len(shape) == 4:
+                split_dim, spec = 2, P(None, None, "tensor", None)
+            elif "proj" in path and len(shape) == 3:
+                split_dim, spec = 0, P("tensor", None, None)
+            elif "Dense_0" in path and len(shape) == 2:
+                split_dim, spec = 1, P(None, "tensor")
+            elif "Dense_1" in path and len(shape) == 2:
+                split_dim, spec = 0, P("tensor", None)
+            if split_dim is not None:
+                if shape[split_dim] % tensor == 0:
+                    return spec
+                _warn_tensor_dropback(path, shape, tensor)
         if "bias" in path and len(shape) == 1 and "Dense_0" in path \
                 and shape[0] % tensor == 0:
             return P("tensor")

@@ -265,6 +265,9 @@ class Trainer:
                     raise ValueError(
                         f"vit_num_experts={cfg.model.vit_num_experts} not "
                         f"divisible by the expert axis ({n_exp_axis})")
+                # indivisible tensor splits (expert FFNs etc.) warn at the
+                # drop-back site itself: parallel/sharding.py
+                # _warn_tensor_dropback covers every leaf, not just MoE
             # MoE×tensor composes since round 5: expert FFNs are
             # Megatron-split over `tensor` (parallel/sharding.py SwitchMlp
             # rule, stacked_encoder_spec moe leaves, expert_ffn psum), so

@@ -34,6 +34,32 @@ def test_create_mesh_dp(mesh8):
         local_batch_size(10, mesh8)
 
 
+def test_tensor_dropback_warns_once(caplog):
+    """An indivisible tensor split silently replicating the leaf's FLOPs
+    must be loud (once per leaf shape): the sharding rule logs the
+    drop-back for plain encoder kernels AND MoE expert leaves."""
+    import logging
+    from distributed_resnet_tensorflow_tpu.parallel import sharding as sh
+    mesh = create_mesh(MeshConfig(data=4, tensor=2))
+    sh._TENSOR_DROPBACK_WARNED.clear()
+    with caplog.at_level(logging.WARNING):
+        spec = param_sharding_rule(
+            "['EncoderBlock_0']['Dense_0']['kernel']", (32, 33), mesh)
+        assert spec == P()  # dropped back to replication (33 % 2 != 0)
+        spec = param_sharding_rule(
+            "['EncoderBlock_0']['SwitchMlp_0']['w1']", (4, 32, 33), mesh)
+        assert "tensor" not in tuple(spec)
+        # repeat: warned once per distinct leaf shape
+        param_sharding_rule(
+            "['EncoderBlock_0']['Dense_0']['kernel']", (32, 33), mesh)
+    msgs = [r for r in caplog.records if "REPLICATE" in r.getMessage()]
+    assert len(msgs) == 2
+    # divisible shapes stay silent and sharded
+    assert param_sharding_rule(
+        "['EncoderBlock_0']['Dense_0']['kernel']", (32, 64), mesh) \
+        == P(None, "tensor")
+
+
 def test_shard_batch_places_on_batch_axis(mesh8):
     batch = {"images": np.zeros((16, 8, 8, 3), np.float32),
              "labels": np.zeros((16,), np.int32)}
