@@ -70,12 +70,26 @@ def load_library(auto_build: bool = True) -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    stale = os.path.exists(_SO_PATH) and not _so_exports(b"drt_has_jpeg")
+    stale = os.path.exists(_SO_PATH) and not _so_exports(b"drt_prefetch_stop")
     if not os.path.exists(_SO_PATH) or stale:
         if not (auto_build and _build()) and not os.path.exists(_SO_PATH):
             raise NativeUnavailable(
                 f"{_SO_PATH} not built (run `make -C {_NATIVE_DIR}`)")
-    lib = ctypes.CDLL(_SO_PATH)
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        # corrupt / wrong-arch / partially-written .so: the documented
+        # contract is silent fallback to the python paths, so map the
+        # loader error onto the exception callers already handle
+        raise NativeUnavailable(f"{_SO_PATH} failed to load: {e}") from e
+    if not hasattr(lib, "drt_prefetch_stop"):
+        # stale build mapped and the rebuild failed (no toolchain, or
+        # another component dlopened the old file first — glibc caches by
+        # inode). The bindings below would AttributeError; surface the
+        # canonical exception so callers fall back to the python paths.
+        raise NativeUnavailable(
+            f"{_SO_PATH} is a stale build missing drt_prefetch_stop and "
+            f"could not be rebuilt (run `make -C {_NATIVE_DIR}`)")
     if not hasattr(lib, "drt_has_jpeg"):
         # pre-JPEG-tier build still mapped (rebuild failed, or another
         # component dlopened the stale file first) — the JPEG fast path is
@@ -101,6 +115,10 @@ def load_library(auto_build: bool = True) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64)]
     lib.drt_prefetch_crc_errors.restype = ctypes.c_int64
     lib.drt_prefetch_crc_errors.argtypes = [ctypes.c_void_p]
+    lib.drt_prefetch_truncated.restype = ctypes.c_int64
+    lib.drt_prefetch_truncated.argtypes = [ctypes.c_void_p]
+    lib.drt_prefetch_stop.restype = None
+    lib.drt_prefetch_stop.argtypes = [ctypes.c_void_p]
     lib.drt_prefetch_destroy.restype = None
     lib.drt_prefetch_destroy.argtypes = [ctypes.c_void_p]
     if hasattr(lib, "drt_has_jpeg"):
@@ -133,10 +151,17 @@ def masked_crc32c(data: bytes) -> int:
 
 
 def load_cifar_native(path: str, label_bytes: int, label_offset: int,
-                      max_records: int = 60000
+                      max_records: int = 0
                       ) -> Tuple[np.ndarray, np.ndarray]:
-    """CIFAR binary file → (HWC uint8 images, int32 labels), parsed in C++."""
+    """CIFAR binary file → (HWC uint8 images, int32 labels), parsed in C++.
+
+    ``max_records`` 0 (default) sizes the buffers from the file itself, so
+    files larger than the standard 60k-record datasets load in full —
+    identical output to the python parser, which has no cap."""
     lib = load_library()
+    if max_records <= 0:
+        record_len = label_bytes + 32 * 32 * 3
+        max_records = max(1, os.path.getsize(path) // record_len)
     images = np.empty((max_records, 32, 32, 3), np.uint8)
     labels = np.empty((max_records,), np.int32)
     n = lib.drt_cifar_load(
@@ -150,10 +175,27 @@ def load_cifar_native(path: str, label_bytes: int, label_offset: int,
 
 
 class NativePrefetcher:
-    """Iterate raw TFRecord payloads produced by C++ reader threads."""
+    """Iterate raw TFRecord payloads produced by C++ reader threads.
+
+    Thread contract: one consumer thread iterates; ``close()`` may run
+    from another thread (teardown, __del__). Protocol: close() nulls the
+    handle under ``_lock`` (no NEW C calls can start), calls
+    ``drt_prefetch_stop`` (wakes a consumer BLOCKED inside
+    ``drt_prefetch_next`` — the stop flag satisfies its wait predicate),
+    waits for the in-flight counter to drain, and only then destroys —
+    so the native object is never freed under a live call and close()
+    never waits on data arrival. A damaged shard is LOUD: mid-record
+    truncation raises IOError at end of stream (matching
+    data/tfrecord.py), and skipped-CRC records warn."""
 
     def __init__(self, paths: List[str], num_threads: int = 4,
                  capacity: int = 512, verify_crc: bool = False):
+        import threading
+        self._lock = threading.Lock()  # first: __del__ may see a partial init
+        self._inflight = 0
+        self._handle = None
+        self._final_crc_errors = 0
+        self._final_truncated = 0
         self._lib = load_library()
         arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
         self._handle = self._lib.drt_prefetch_create(
@@ -166,15 +208,34 @@ class NativePrefetcher:
         return self
 
     def __next__(self) -> bytes:
-        if self._handle is None:
-            raise StopIteration
-        needed = ctypes.c_int64(0)
         while True:
-            n = self._lib.drt_prefetch_next(
-                self._handle,
-                self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                self._buf.size, ctypes.byref(needed))
+            with self._lock:
+                if self._handle is None:
+                    raise StopIteration
+                self._inflight += 1
+                h = self._handle
+            truncated = crc = 0
+            try:
+                needed = ctypes.c_int64(0)
+                n = self._lib.drt_prefetch_next(
+                    h,
+                    self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    self._buf.size, ctypes.byref(needed))
+                if n == 0:  # end of stream: read the error counters while
+                    truncated = self._lib.drt_prefetch_truncated(h)
+                    crc = self._lib.drt_prefetch_crc_errors(h)  # h is live
+            finally:
+                with self._lock:
+                    self._inflight -= 1
             if n == 0:
+                if crc:
+                    log.warning("native prefetcher skipped %d record(s) "
+                                "with bad CRC", crc)
+                if truncated:
+                    raise IOError(
+                        f"truncated/corrupt TFRecord framing in {truncated} "
+                        "file(s) — stream is incomplete (the python reader "
+                        "raises the same way)")
                 raise StopIteration
             if n == -1:
                 self._buf = np.empty(int(needed.value) * 2, np.uint8)
@@ -183,16 +244,36 @@ class NativePrefetcher:
 
     @property
     def crc_errors(self) -> int:
-        if self._handle is None:
-            return self._final_crc_errors
-        return self._lib.drt_prefetch_crc_errors(self._handle)
+        with self._lock:
+            if self._handle is None:
+                return self._final_crc_errors
+            return self._lib.drt_prefetch_crc_errors(self._handle)
+
+    @property
+    def truncated(self) -> int:
+        with self._lock:
+            if self._handle is None:
+                return self._final_truncated
+            return self._lib.drt_prefetch_truncated(self._handle)
 
     def close(self) -> None:
-        if self._handle is not None:
-            self._final_crc_errors = self._lib.drt_prefetch_crc_errors(
-                self._handle)
-            self._lib.drt_prefetch_destroy(self._handle)
-            self._handle = None
+        import time
+        with self._lock:
+            h, self._handle = self._handle, None
+        if h is None:
+            return
+        # wake a consumer blocked inside drt_prefetch_next; it returns 0
+        # and decrements _inflight (its properties reads use the local h,
+        # still alive until destroy below)
+        self._lib.drt_prefetch_stop(h)
+        while True:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.001)
+        self._final_crc_errors = self._lib.drt_prefetch_crc_errors(h)
+        self._final_truncated = self._lib.drt_prefetch_truncated(h)
+        self._lib.drt_prefetch_destroy(h)
 
     def __del__(self):
         try:

@@ -199,12 +199,19 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         hooks.append(CheckpointHook(manager))
 
     num_steps = max_steps if max_steps is not None else cfg.train.train_steps
-    state, metrics = trainer.train(data_iter, num_steps=num_steps,
-                                   hooks=tuple(hooks), start_step=start_step)
-    # final checkpoint + drain async saves
-    if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
-        manager.save(int(state.step), state, force=True)
-    manager.close()
+    try:
+        state, metrics = trainer.train(data_iter, num_steps=num_steps,
+                                       hooks=tuple(hooks),
+                                       start_step=start_step)
+        # final checkpoint + drain async saves
+        if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
+            manager.save(int(state.step), state, force=True)
+    finally:
+        manager.close()
+        if writer is not None:
+            # tensorboardX buffers events (~2 min flush window): without
+            # the close, the tail of a completed run's summaries is lost
+            writer.close()
     return state, metrics
 
 
@@ -213,8 +220,12 @@ def run_eval(cfg: ExperimentConfig, max_evals: Optional[int] = None,
     writer = None
     if is_chief():
         writer = MetricsWriter(os.path.join(cfg.log_root, "eval"))
-    ev = Evaluator(cfg, writer=writer)
-    return ev.run(max_evals=max_evals, timeout_secs=timeout_secs)
+    try:
+        ev = Evaluator(cfg, writer=writer)
+        return ev.run(max_evals=max_evals, timeout_secs=timeout_secs)
+    finally:
+        if writer is not None:
+            writer.close()  # flush buffered events (see run_train)
 
 
 def run_train_and_eval(cfg: ExperimentConfig):
@@ -249,23 +260,29 @@ def run_train_and_eval(cfg: ExperimentConfig):
     best = 0.0
     step = int(trainer.state.step)
     result = {}
-    while step < cfg.train.train_steps:
-        target = min(step + every, cfg.train.train_steps)
-        state, _ = trainer.train(train_iter, num_steps=target,
-                                 hooks=tuple(hooks), start_step=step)
-        step = int(state.step)
-        # fresh iterator per round: the ImageNet eval stream is one-pass
-        result = trainer.evaluate(make_eval_iterator(cfg, trainer.mesh),
-                                  cfg.eval.eval_batch_count)
-        best = max(best, result["precision"])
+    try:
+        while step < cfg.train.train_steps:
+            target = min(step + every, cfg.train.train_steps)
+            state, _ = trainer.train(train_iter, num_steps=target,
+                                     hooks=tuple(hooks), start_step=step)
+            step = int(state.step)
+            # fresh iterator per round: the ImageNet eval stream is one-pass
+            result = trainer.evaluate(make_eval_iterator(cfg, trainer.mesh),
+                                      cfg.eval.eval_batch_count)
+            best = max(best, result["precision"])
+            if writer:
+                writer.write_scalars(
+                    step, {"eval/precision": result["precision"],
+                           "eval/best_precision": best})
+            if is_chief():
+                print(f"eval @ step {step}: precision "
+                      f"{result['precision']:.4f} best {best:.4f}")
+        manager.save(step, trainer.state, force=True)
+    finally:
+        manager.close()
         if writer:
-            writer.write_scalars(step, {"eval/precision": result["precision"],
-                                        "eval/best_precision": best})
-        if is_chief():
-            print(f"eval @ step {step}: precision {result['precision']:.4f} "
-                  f"best {best:.4f}")
-    manager.save(step, trainer.state, force=True)
-    manager.close()
+            # flush buffered tensorboardX events even on a mid-run error
+            writer.close()
     return trainer.state, {**result, "best_precision": best}
 
 

@@ -19,6 +19,7 @@
 // and the Python PIL path (also scaled: PIL draft) serves instead.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -138,6 +139,14 @@ struct Prefetcher {
   std::atomic<int> live_readers{0};
   std::atomic<bool> stop{false};
   std::atomic<int64_t> crc_errors{0};
+  // records lost to mid-record EOF / corrupt length framing (distinct
+  // from clean end-of-file) -- surfaced so a damaged shard is loud
+  // (the python reader raises on truncation; silent data loss is the
+  // failure mode this counter closes)
+  std::atomic<int64_t> truncated{0};
+  // consumers currently inside drt_prefetch_next: destroy must not free
+  // the object while a thread is blocked on not_empty using p->mu
+  std::atomic<int> active_consumers{0};
   bool verify_crc = false;
   std::vector<std::thread> threads;
 };
@@ -147,15 +156,23 @@ static bool read_file_records(Prefetcher* p, const std::string& path) {
   if (!f) return false;
   uint8_t header[12];
   while (!p->stop.load(std::memory_order_relaxed)) {
-    if (fread(header, 1, 12, f) != 12) break;
+    size_t got = fread(header, 1, 12, f);
+    if (got == 0) break;  // clean end of file
+    if (got != 12) { p->truncated.fetch_add(1); break; }
     uint64_t len;
     memcpy(&len, header, 8);
-    if (len > (1ull << 31)) break;  // corrupt length guard
+    if (len > (1ull << 31)) {  // corrupt length: framing is lost for the
+      p->truncated.fetch_add(1);  // rest of the file
+      break;
+    }
     Record rec;
     rec.data.resize(len);
-    if (fread(rec.data.data(), 1, len, f) != len) break;
+    if (fread(rec.data.data(), 1, len, f) != len) {
+      p->truncated.fetch_add(1);
+      break;
+    }
     uint8_t footer[4];
-    if (fread(footer, 1, 4, f) != 4) break;
+    if (fread(footer, 1, 4, f) != 4) { p->truncated.fetch_add(1); break; }
     if (p->verify_crc) {
       uint32_t want;
       memcpy(&want, footer, 4);
@@ -207,6 +224,12 @@ void* drt_prefetch_create(const char** paths, int32_t num_paths,
 int64_t drt_prefetch_next(void* handle, uint8_t* buf, int64_t cap,
                           int64_t* needed) {
   auto* p = static_cast<Prefetcher*>(handle);
+  struct ConsumerGuard {
+    std::atomic<int>& c;
+    ~ConsumerGuard() { c.fetch_sub(1); }
+  };
+  p->active_consumers.fetch_add(1);
+  ConsumerGuard guard{p->active_consumers};
   std::unique_lock<std::mutex> lock(p->mu);
   p->not_empty.wait(lock, [p] {
     return !p->ring.empty() || p->live_readers.load() == 0 || p->stop.load();
@@ -226,6 +249,22 @@ int64_t drt_prefetch_crc_errors(void* handle) {
   return static_cast<Prefetcher*>(handle)->crc_errors.load();
 }
 
+int64_t drt_prefetch_truncated(void* handle) {
+  return static_cast<Prefetcher*>(handle)->truncated.load();
+}
+
+// Wake every blocked reader/consumer WITHOUT freeing anything: the python
+// close() protocol is stop -> wait for its in-flight next() calls to
+// return -> destroy, so a consumer blocked on not_empty can never hold up
+// (or race) the free.
+void drt_prefetch_stop(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  std::lock_guard<std::mutex> lock(p->mu);
+  p->stop.store(true);
+  p->not_full.notify_all();
+  p->not_empty.notify_all();
+}
+
 void drt_prefetch_destroy(void* handle) {
   auto* p = static_cast<Prefetcher*>(handle);
   {
@@ -238,6 +277,18 @@ void drt_prefetch_destroy(void* handle) {
     p->not_empty.notify_all();
   }
   for (auto& t : p->threads) t.join();
+  // a consumer may still be inside drt_prefetch_next (blocked on
+  // not_empty, or copying a record): stop is set so its wait predicate is
+  // satisfied -- keep notifying and wait for it to leave before freeing
+  // the mutex/condvar it is using
+  while (p->active_consumers.load() != 0) {
+    {
+      std::lock_guard<std::mutex> lock(p->mu);
+      p->not_empty.notify_all();
+      p->not_full.notify_all();
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
   delete p;
 }
 

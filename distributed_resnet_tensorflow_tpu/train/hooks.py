@@ -39,6 +39,13 @@ class LoggingHook:
         self.step_flops = step_flops  # enables an MFU column when known
         self._last = 0
 
+    def reset_window(self) -> None:
+        """Called by Trainer.train at segment start so the throughput
+        window never spans an eval round / checkpoint pause between
+        segments (which would deflate stp/s and MFU for the first line
+        of each segment)."""
+        self.throughput.reset()
+
     def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
         if not cadence_crossed(step, self.every_steps, self._last):
             return

@@ -537,6 +537,10 @@ class Trainer:
         """
         if self.state is None:
             self.init_state()
+        for h in hooks:
+            reset = getattr(h, "reset_window", None)
+            if reset is not None:  # throughput windows must not span the
+                reset()            # pause between train segments
         if self.cfg.model.norm == "group" \
                 and not getattr(self, "_gn_lr_warned", False):
             # measured (docs/perf_norm_r5.md): GroupNorm starting at bare
@@ -580,7 +584,13 @@ class Trainer:
                     device_prefetch(iter(data_iter), put_one, depth=2))
             dev_iter = self._dev_prefetch[1]
             for step in range(start_step, num_steps):
-                self.state, metrics = step_fn(self.state, next(dev_iter))
+                try:
+                    batch = next(dev_iter)
+                except StopIteration:
+                    # finite stream exhausted: end training cleanly, same
+                    # contract as the fused k>1 path
+                    return self.state, metrics
+                self.state, metrics = step_fn(self.state, batch)
                 for h in hooks:
                     h(step + 1, self.state, metrics)
             return self.state, metrics

@@ -25,7 +25,13 @@ Schedule = Callable[[jnp.ndarray], jnp.ndarray]
 
 
 def piecewise(boundaries: Sequence[int], values: Sequence[float]) -> Schedule:
-    """Step-piecewise constant. len(values) == len(boundaries) + 1."""
+    """Step-piecewise constant. len(values) == len(boundaries) + 1.
+
+    Boundary semantics: the value switches AT the boundary step (step >=
+    boundary → next value) — matching the reference's own LR hook
+    (``train_step < 40000 → 0.1, elif < 60000 → 0.01``, reference
+    resnet_cifar_main.py:300-307), NOT tf.piecewise_constant (which holds
+    values[i] through step == boundaries[i])."""
     if len(values) != len(boundaries) + 1:
         raise ValueError(f"need {len(boundaries)+1} values, got {len(values)}")
     b = jnp.asarray(boundaries, dtype=jnp.int32)

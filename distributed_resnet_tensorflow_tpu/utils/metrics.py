@@ -89,6 +89,12 @@ class Throughput:
         self._t0: Optional[float] = None
         self._step0: Optional[int] = None
 
+    def reset(self) -> None:
+        """Restart the window — call when training resumes after a pause
+        (eval round, checkpoint restore): a window spanning non-training
+        wall time would deflate steps/sec and the derived MFU column."""
+        self._t0 = self._step0 = None
+
     def update(self, step: int) -> Dict[str, float]:
         now = time.monotonic()
         if self._t0 is None:

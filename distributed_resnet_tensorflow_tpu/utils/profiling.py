@@ -21,9 +21,12 @@ import jax
 
 log = logging.getLogger(__name__)
 
-# bf16 peak TFLOP/s per chip by TPU generation (public spec-sheet numbers)
+# bf16 peak TFLOP/s per JAX DEVICE by TPU generation (public spec-sheet
+# numbers). mfu() multiplies by jax.device_count(), and on v2/v3 JAX
+# exposes each of the chip's 2 cores as a device — so those entries are
+# per-CORE (chip peak / 2); v4+ are one device per chip.
 TPU_PEAK_TFLOPS = {
-    "v2": 45.0, "v3": 123.0 / 2,          # v3 number is per-chip (2 cores)
+    "v2": 45.0 / 2, "v3": 123.0 / 2,
     "v4": 275.0, "v5e": 197.0, "v5 lite": 197.0, "v5p": 459.0, "v6e": 918.0,
 }
 

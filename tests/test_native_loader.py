@@ -83,6 +83,65 @@ def test_native_prefetcher_skips_corrupt_records(tmp_path):
     assert pf.crc_errors == 1
 
 
+def test_native_prefetcher_truncated_shard_raises(tmp_path):
+    """Mid-record EOF must be LOUD like the python reader (which raises
+    IOError 'truncated record'), not a silent partial dataset."""
+    path = os.path.join(tmp_path, "trunc")
+    write_tfrecords(path, [b"record-one", b"record-two"])
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-7])  # cut into the last record's footer
+    pf = nl.NativePrefetcher([path], num_threads=1)
+    with pytest.raises(IOError, match="truncated"):
+        list(pf)
+    assert pf.truncated == 1
+    pf.close()
+    assert pf.truncated == 1  # survives close
+
+
+def test_native_cifar_load_beyond_60k(tmp_path):
+    """The native CIFAR parser sizes its buffers from the file: >60000
+    records load in full, identical to the python parser (no silent cap)."""
+    n = 60004
+    rec = np.zeros((n, 3073), np.uint8)
+    rec[:, 0] = np.arange(n) % 10
+    path = os.path.join(tmp_path, "big.bin")
+    rec.tofile(path)
+    images, labels = nl.load_cifar_native(str(path), 1, 0)
+    assert len(labels) == n
+    assert labels[-1] == (n - 1) % 10
+
+
+def test_native_prefetcher_close_during_iteration(tmp_path):
+    """close() from another thread while a consumer iterates: the consumer
+    must end cleanly (StopIteration via the stop flag) and close must not
+    free the native object under a live drt_prefetch_next call (the
+    stop → drain in-flight → destroy protocol)."""
+    import threading
+    import time as _time
+    rng = np.random.RandomState(3)
+    path = os.path.join(tmp_path, "many")
+    write_tfrecords(path, [rng.bytes(2048) for _ in range(5000)])
+    pf = nl.NativePrefetcher([path] * 4, num_threads=2)
+    seen = []
+    errors = []
+
+    def consume():
+        try:
+            for rec in pf:
+                seen.append(len(rec))
+        except Exception as e:  # pragma: no cover - would fail the assert
+            errors.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    _time.sleep(0.05)
+    pf.close()
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "consumer failed to terminate after close()"
+    assert not errors, errors
+    assert pf.truncated == 0
+
+
 def test_native_prefetcher_large_records(tmp_path):
     """Records larger than the initial 1MB buffer trigger the regrow path."""
     big = os.urandom(3 << 20)

@@ -348,6 +348,20 @@ def test_segmented_tail_remainder_no_skip():
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_finite_stream_ends_training_k1():
+    """Same contract on the k==1 (unfused) path: exhaustion ends training
+    cleanly instead of leaking StopIteration out of Trainer.train."""
+    cfg = _tiny_cfg()
+    cfg.train.steps_per_loop = 1
+    tr = Trainer(cfg)
+    tr.init_state()
+    src = learnable_synthetic_iterator(16, 8, 4)
+    finite = iter([next(src) for _ in range(5)])
+    state, m = tr.train(finite, num_steps=100)
+    assert int(state.step) == 5
+    assert m is not None and np.isfinite(float(m["loss"]))
+
+
 def test_finite_stream_ends_training_at_last_full_group():
     """A deliberately truncated input ends training cleanly (the reference's
     serial path stopped on input exhaustion too, SURVEY.md §3.5)."""
