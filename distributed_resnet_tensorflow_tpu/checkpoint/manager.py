@@ -1,4 +1,4 @@
-"""Checkpoint management — orbax-backed, async, auto-resuming.
+"""Checkpoint management — crash-consistent, verified, auto-resuming.
 
 Capability parity with the reference's checkpointing (SURVEY.md §2.14):
   * chief-written, time-based checkpoints every 60 s (CIFAR) / 600 s
@@ -9,23 +9,48 @@ Capability parity with the reference's checkpointing (SURVEY.md §2.14):
   * read-only polling restore for the evaluator
     (reference resnet_cifar_eval.py:101-109).
 
-TPU-native upgrades: checkpoints are sharded-array aware (every process
-participates in saving its shards — there is no single "chief" writing the
-full state over NFS), saves are asynchronous (training continues while the
-previous step serializes), and both step-based and time-based cadences are
-supported simultaneously.
+Beyond the reference, saves are CRASH-CONSISTENT (resilience/manifest.py):
+arrays serialize (orbax) into a staging dir, a manifest with per-file sizes
+and SHA-256 checksums is fsynced, and a single atomic rename commits the
+step — a preemption or crash at any instant leaves either a fully-committed
+checkpoint or none, never a torn one under a committed name. ``restore()``
+verifies the manifest and, instead of crashing on damage, falls back to the
+newest OLDER checkpoint that still verifies; ``wait_for_new_checkpoint``
+(the evaluator's polling primitive) only ever reports committed steps. The
+reference's ``tf.train.Saver``/``latest_checkpoint`` pair trusted the
+filesystem blindly on both counts.
+
+TPU-native as before: checkpoints are sharded-array aware (every process
+participates in saving its shards), saves can be asynchronous (training
+continues while the previous state serializes from a host-side snapshot),
+and step- and time-based cadences are supported simultaneously.
 """
 from __future__ import annotations
 
 import logging
 import os
+import shutil
 import time
-from typing import Any, Optional, Tuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, List, Optional, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
+from ..resilience.manifest import (committed_steps, manifest_status,
+                                   staging_path, sweep_staging,
+                                   fsync_dir, write_manifest)
+from ..resilience.retry import retry_call
+
 log = logging.getLogger(__name__)
+
+_PAYLOAD_DIR = "data"          # our layout: <dir>/<step>/data/...
+_LEGACY_PAYLOAD_DIR = "default"  # pre-manifest orbax CheckpointManager layout
+
+
+class CheckpointCorrupt(RuntimeError):
+    """An explicitly-requested checkpoint failed verification/restore."""
 
 
 def _saveable(state) -> dict:
@@ -38,8 +63,16 @@ def _saveable(state) -> dict:
     }
 
 
+def _host_snapshot(tree):
+    """Blocking device→host copy of every jax.Array leaf, so an async write
+    can proceed while the train loop donates/overwrites the live buffers
+    (same contract orbax's async checkpointing provides)."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree)
+
+
 class CheckpointManager:
-    """Thin policy wrapper over ``orbax.checkpoint.CheckpointManager``.
+    """Commit-protocol checkpoint store with the save-cadence policy.
 
     save cadence = step-based (``save_every_steps``) OR time-based
     (``save_every_secs``), whichever fires first — the reference only had the
@@ -49,7 +82,10 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 5,
                  save_every_steps: int = 0, save_every_secs: float = 0.0,
                  async_save: bool = True,
-                 layout_stamp: Optional[dict] = None):
+                 layout_stamp: Optional[dict] = None,
+                 verify_on_restore: bool = True,
+                 io_retries: int = 3,
+                 writer: bool = True):
         # layout_stamp: declares how depth-stacked params are ORDERED (the
         # circular pipeline schedule stores stage-major order, a function of
         # (pstages, interleave) — models/pipeline.py). Saved as a sidecar so
@@ -60,13 +96,33 @@ class CheckpointManager:
         self._layout_stamp = layout_stamp
         self.save_every_steps = save_every_steps
         self.save_every_secs = save_every_secs
+        self.max_to_keep = max_to_keep
+        self.verify_on_restore = verify_on_restore
+        self.io_retries = io_retries
         self._last_save_time = time.monotonic()
         self._last_save_step = 0
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
-            enable_async_checkpointing=async_save,
-        )
-        self._mngr = ocp.CheckpointManager(self.directory, options=options)
+        # a truly SYNCHRONOUS checkpointer (ocp.StandardCheckpointer is
+        # async under the hood): the commit rename must not race orbax's
+        # background writer — async happens on OUR worker thread, over a
+        # host snapshot, with the whole stage→manifest→rename sequence
+        self._ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+        # async: host-snapshot on the caller thread (correct wrt donated
+        # buffers), serialize+commit on one background worker. Multi-process
+        # saves are synchronous: every process must participate in the
+        # sharded write, and a per-process thread would skew the barrier.
+        self._async = async_save and jax.process_count() == 1
+        self._executor = (ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="drt-ckpt")
+            if self._async else None)
+        self._pending: Optional[Future] = None
+        if writer and jax.process_index() == 0:
+            # stale staging dirs are uncommitted leftovers of a crashed or
+            # preempted writer; a WRITER constructing here means no other
+            # writer is live on this directory. Read-side managers (the
+            # polling evaluator, ``writer=False``) must NOT sweep — they
+            # share the directory with a live trainer whose in-flight
+            # async save owns the staging dir they'd be deleting
+            sweep_staging(self.directory)
         # fail at construction, not at the first save cadence minutes into
         # training: everything the layout check needs already exists here
         self._check_layout()
@@ -90,7 +146,7 @@ class CheckpointManager:
         self.save(step, state)
         return True
 
-    # -- mechanics ---------------------------------------------------------
+    # -- layout sidecar ----------------------------------------------------
     @property
     def _layout_path(self) -> str:
         return os.path.join(self.directory, "layout.json")
@@ -126,10 +182,10 @@ class CheckpointManager:
         if saved is not None:
             af = saved.get("applies_from_step")
             if af is not None and af > latest:
-                # the sidecar is written before the (async) orbax commit; a
-                # crash between the two leaves a stamp describing a step
-                # that never landed. Ignore it — the committed checkpoints
-                # all predate it (ADVICE r3 #4)
+                # the sidecar is written before the commit; a crash between
+                # the two leaves a stamp describing a step that never
+                # landed. Ignore it — the committed checkpoints all predate
+                # it (ADVICE r3 #4)
                 saved = None
         # checkpoints that predate layout stamping could only have been
         # network order
@@ -148,8 +204,8 @@ class CheckpointManager:
         # chief-only + atomic: every host shares this directory, and
         # concurrent truncating writes could leave unparseable JSON.
         # ``applies_from_step`` records the first step this stamp describes,
-        # so a stamp orphaned by a crash before the async commit can be
-        # recognized (newer than every committed step) and ignored
+        # so a stamp orphaned by a crash before the commit can be recognized
+        # (newer than every committed step) and ignored
         if jax.process_index() != 0:
             return
         import json
@@ -164,8 +220,25 @@ class CheckpointManager:
             if os.path.exists(tmp):
                 os.unlink(tmp)
 
+    # -- commit protocol ---------------------------------------------------
+    def all_steps(self) -> List[int]:
+        """Committed steps (ascending). Staging/tmp dirs never appear."""
+        return committed_steps(self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
     def save(self, step: int, state, force: bool = False) -> None:
-        if step in self._mngr.all_steps():
+        """Commit ``state`` as step ``step`` (no-op if already committed).
+
+        ``force=True`` additionally OVERWRITES an existing committed step:
+        the final/preemption saves rely on it — a stale same-numbered
+        checkpoint from an earlier run in the same directory must not
+        swallow the current state (the cadence policy lives in
+        ``maybe_save``, which never forces)."""
+        self.wait_until_finished()  # serialize with an in-flight async save
+        if step in self.all_steps() and not force:
             return  # idempotent: step already checkpointed
         self._check_layout()
         if self._layout_stamp is not None:
@@ -177,59 +250,287 @@ class CheckpointManager:
             if (self._strip_meta(saved) != self._layout_stamp
                     or (saved or {}).get("applies_from_step", step) > step):
                 self._write_layout(step)
-        self._mngr.save(step, args=ocp.args.StandardSave(_saveable(state)),
-                        force=force)
+        tree = _saveable(state)
+        if self._async:
+            snapshot = _host_snapshot(tree)
+            self._pending = self._executor.submit(self._write, step,
+                                                  snapshot, force)
+        else:
+            self._write(step, tree, force)
         self._last_save_time = time.monotonic()
         self._last_save_step = step
 
-    def latest_step(self) -> Optional[int]:
-        return self._mngr.latest_step()
+    def _write(self, step: int, tree, force: bool = False) -> None:
+        """Stage → manifest(fsync) → rename(commit) → retention."""
+        staging = staging_path(self.directory, step)
+        final = os.path.join(self.directory, str(step))
+        chief = jax.process_index() == 0
+
+        def write_and_commit():
+            if os.path.isdir(final):
+                if not force:
+                    # the commit may have landed on a previous attempt whose
+                    # error came after the rename (parent-dir fsync): done
+                    return
+                # forced overwrite: move the stale same-numbered dir aside
+                # (it stops being "committed" the moment the rename lands;
+                # the brief no-committed-step window only risks falling
+                # back one step on a crash exactly here)
+                if chief:
+                    aside = final + ".replaced"
+                    shutil.rmtree(aside, ignore_errors=True)
+                    os.replace(final, aside)
+                    shutil.rmtree(aside, ignore_errors=True)
+            # fresh staging per attempt: a failed try leaves partial orbax
+            # state (incl. its own tmp dirs) that must not pollute the
+            # manifest of a successful retry
+            if chief and os.path.isdir(staging):
+                shutil.rmtree(staging)
+            # every process participates: orbax writes this process's array
+            # shards and barriers internally before finalizing the payload
+            self._ckptr.save(os.path.join(staging, _PAYLOAD_DIR),
+                             args=ocp.args.StandardSave(tree))
+            if chief:
+                write_manifest(staging, step)
+                os.replace(staging, final)
+                fsync_dir(self.directory)
+
+        multi = jax.process_count() > 1
+        error: Optional[BaseException] = None
+        try:
+            # the retried region covers the WHOLE stage→manifest→rename
+            # sequence — on flaky NFS the manifest fsyncs and the rename
+            # are as OSError-prone as the write; each attempt is idempotent
+            # (staging rebuilt, a landed commit short-circuits). Retries
+            # are single-process only: orbax's sharded save barriers
+            # internally, so one process re-entering it while the others
+            # have moved on would desync the collective
+            retry_call(write_and_commit,
+                       retries=self.io_retries if not multi else 0,
+                       retry_on=(OSError,),
+                       description=f"checkpoint write (step {step})")
+        except BaseException as e:
+            error = e
+            if chief:
+                shutil.rmtree(staging, ignore_errors=True)
+        if multi:
+            # the barrier is reached on BOTH success and failure paths: no
+            # process may report the save finished (or exit, for the final
+            # preemption save) before the commit rename is visible, and a
+            # chief-side commit error must not strand the others here.
+            # (A process that died outright still hangs peers until orbax's
+            # barrier timeout — that is the distributed-runtime failure
+            # domain, not ours.)
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"drt_ckpt_commit_{step}")
+        if error is not None:
+            raise error
+        if chief:
+            self._apply_retention()
+
+    def _apply_retention(self) -> None:
+        if not self.max_to_keep or self.max_to_keep <= 0:
+            return
+        steps = self.all_steps()
+        for old in steps[:-self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.directory, str(old)),
+                          ignore_errors=True)
+
+    # -- restore -----------------------------------------------------------
+    def _payload_path(self, step: int) -> str:
+        step_dir = os.path.join(self.directory, str(step))
+        for name in (_PAYLOAD_DIR, _LEGACY_PAYLOAD_DIR):
+            cand = os.path.join(step_dir, name)
+            if os.path.isdir(cand):
+                return cand
+        return step_dir  # bare orbax tree (oldest layout)
+
+    def _quarantine(self, step: int) -> None:
+        """Move a damaged checkpoint aside (``<step>.corrupt``): the commit
+        protocol keys idempotency on committed step numbers, so a damaged
+        dir left under its committed name would block the re-trained step
+        from ever committing again. Chief-only; losing the race on a shared
+        FS is harmless (the other rename already did the job)."""
+        if jax.process_index() != 0:
+            return
+        src = os.path.join(self.directory, str(step))
+        dst = src + ".corrupt"
+        try:
+            if os.path.isdir(dst):
+                shutil.rmtree(dst)
+            os.replace(src, dst)
+            log.warning("quarantined damaged checkpoint step %d -> %s",
+                        step, dst)
+        except OSError:
+            pass
+
+    def _agreed_pick(self) -> Optional[int]:
+        """Chief verifies candidates newest-first and broadcasts the first
+        step that passes (or -1 for none); peers follow the broadcast. Must
+        be called by ALL processes at the same program point."""
+        import numpy as np
+        from jax.experimental import multihost_utils
+        pick = -1  # -1: no checkpoints at all; -2: all damaged (loud)
+        if jax.process_index() == 0:
+            steps = sorted(self.all_steps(), reverse=True)
+            pick = -2 if steps else -1
+            for s in steps:
+                ok, detail = self._verify(s)
+                if ok:
+                    pick = s
+                    break
+                log.warning("checkpoint step %d failed verification (%s) — "
+                            "falling back to an older checkpoint", s, detail)
+                self._quarantine(s)
+        pick = int(multihost_utils.broadcast_one_to_all(
+            np.asarray(pick, dtype=np.int64)))
+        if pick == -2:
+            raise CheckpointCorrupt(
+                f"every committed checkpoint in {self.directory} failed "
+                "verification — refusing to silently restart from scratch; "
+                "move or delete the directory to start over")
+        return None if pick < 0 else pick
+
+    def _verify(self, step: int) -> Tuple[bool, str]:
+        """(usable, detail) for a committed step per its manifest."""
+        if not self.verify_on_restore:
+            return True, "verification disabled"
+        status, detail = manifest_status(
+            os.path.join(self.directory, str(step)))
+        if status == "bad":
+            return False, detail
+        if status == "legacy":
+            log.info("checkpoint step %d: %s — restoring unverified",
+                     step, detail)
+        return True, detail
 
     def restore(self, state, step: Optional[int] = None):
         """Restore into the sharding/structure of ``state`` (shardings are
-        taken from the abstract target, so restored arrays land exactly where
-        the live ones are). Returns (new_state, restored_step) or
-        (state, None) when no checkpoint exists."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        taken from the abstract target, so restored arrays land exactly
+        where the live ones are). Returns (new_state, restored_step) or
+        (state, None) when no committed checkpoint exists.
+
+        With ``step=None`` the newest VALID checkpoint wins: a candidate
+        whose manifest fails to verify, or whose deserialization throws
+        (torn write that predates the manifest protocol), is skipped with a
+        warning and the next older one is tried. An explicitly requested
+        ``step`` that fails raises :class:`CheckpointCorrupt` instead —
+        the caller asked for that exact state."""
+        # drain an in-flight async save first: its commit rename and
+        # retention rmtree must not race the scan below (a step vanishing
+        # mid-verification would be spuriously quarantined)
+        self.wait_until_finished()
+        explicit = step is not None
+        if jax.process_count() > 1 and not explicit:
+            # multi-host scan: per-process listdir + verify would let stale
+            # NFS attribute caches give hosts DIVERGENT picks (different
+            # steps restored → the next collective hangs). The chief alone
+            # walks its candidates and broadcasts ONE chosen step; every
+            # process then restores exactly that step. Cost: deserialize
+            # failures of the agreed step raise instead of falling back
+            # (manifest-verified fallback is preserved) — orbax's restore
+            # is collective, so a per-host deserialize fallback could
+            # never be safe anyway.
+            step = self._agreed_pick()
+            if step is None:
+                return state, None
+            explicit = True
+            agreed = True
+        else:
+            agreed = False
+        candidates = [step] if explicit else \
+            sorted(self.all_steps(), reverse=True)
+        if not candidates:
             return state, None
         self._check_layout()
         abstract = jax.tree_util.tree_map(
             ocp.utils.to_shape_dtype_struct, _saveable(state))
-        restored = self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
-        new_state = state.replace(
-            step=restored["step"], params=restored["params"],
-            batch_stats=restored["batch_stats"],
-            opt_state=restored["opt_state"])
-        # resume continues the cadence from the restored step — without this,
-        # the first maybe_save after a restart fires immediately off-cadence
-        self._last_save_step = step
-        self._last_save_time = time.monotonic()
-        return new_state, step
+        failures = []
+        for s in candidates:
+            # `agreed`: the chief vouched for this step — a peer's stale
+            # directory listing must not veto it (orbax fails loudly if
+            # the step is truly absent)
+            if explicit and not agreed and s not in self.all_steps():
+                raise FileNotFoundError(
+                    f"checkpoint step {s} is not committed in "
+                    f"{self.directory} (have {self.all_steps()})")
+            # agreed steps were verified (and peers' stale caches must not
+            # re-veto them); everything else verifies here
+            ok, detail = (True, "") if agreed else self._verify(s)
+            if not ok:
+                if explicit:
+                    raise CheckpointCorrupt(
+                        f"checkpoint step {s} failed verification: {detail}")
+                log.warning("checkpoint step %d failed verification (%s) — "
+                            "falling back to an older checkpoint", s, detail)
+                failures.append((s, detail))
+                self._quarantine(s)
+                continue
+            try:
+                restored = self._ckptr.restore(
+                    self._payload_path(s),
+                    args=ocp.args.StandardRestore(abstract))
+            except Exception as e:
+                if explicit:
+                    raise CheckpointCorrupt(
+                        f"checkpoint step {s} failed to deserialize: {e}"
+                    ) from e
+                log.warning("checkpoint step %d failed to deserialize (%s) "
+                            "— falling back to an older checkpoint", s, e)
+                failures.append((s, str(e)))
+                # NO quarantine here: unlike a manifest mismatch (verified
+                # content damage), a deserialization error can be
+                # environmental (host OOM, transient FS) or a caller-side
+                # shape/config mismatch — renaming intact checkpoints
+                # .corrupt on those would let a later resume silently
+                # restart from scratch after the caller fixes their config
+                continue
+            if failures:
+                log.warning(
+                    "restored step %d after skipping damaged checkpoint(s) "
+                    "%s", s, [f[0] for f in failures])
+            new_state = state.replace(
+                step=restored["step"], params=restored["params"],
+                batch_stats=restored["batch_stats"],
+                opt_state=restored["opt_state"])
+            # resume continues the cadence from the restored step — without
+            # this, the first maybe_save after a restart fires immediately
+            # off-cadence
+            self._last_save_step = s
+            self._last_save_time = time.monotonic()
+            return new_state, s
+        raise CheckpointCorrupt(
+            f"every committed checkpoint in {self.directory} failed to "
+            f"restore: {failures} — refusing to silently restart from "
+            "scratch; move or delete the directory to start over")
 
+    # -- teardown ----------------------------------------------------------
     def wait_until_finished(self) -> None:
-        self._mngr.wait_until_finished()
+        """Block until the in-flight async save (if any) has committed;
+        re-raises its error so a failed save can't pass silently."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            pending.result()
 
     def close(self) -> None:
-        self._mngr.wait_until_finished()
-        self._mngr.close()
+        self.wait_until_finished()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
 
 
 def wait_for_new_checkpoint(directory: str, last_seen: Optional[int],
                             timeout_secs: float = 0.0,
                             poll_secs: float = 60.0) -> Optional[int]:
-    """Block until a checkpoint newer than ``last_seen`` appears — the
-    evaluator's polling primitive (reference resnet_cifar_eval.py:99-141
-    polled get_checkpoint_state + slept 60 s). timeout 0 = single poll."""
+    """Block until a COMMITTED checkpoint newer than ``last_seen`` appears —
+    the evaluator's polling primitive (reference resnet_cifar_eval.py:99-141
+    polled get_checkpoint_state + slept 60 s). timeout 0 = single poll.
+
+    Only commit-renamed step dirs are visible (resilience/manifest.py), so
+    the evaluator can never pick up a checkpoint mid-write."""
     deadline = time.monotonic() + timeout_secs if timeout_secs else None
     while True:
-        try:
-            steps = ocp.utils.checkpoint_steps(directory)
-        except (FileNotFoundError, ValueError):
-            steps = []
-        newest = max(steps) if steps else None
+        steps = committed_steps(directory)
+        newest = steps[-1] if steps else None
         if newest is not None and (last_seen is None or newest > last_seen):
             return newest
         if deadline is None or time.monotonic() >= deadline:

@@ -330,8 +330,17 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                     if ended == n_workers:
                         # every worker's items precede its own _END in
                         # queue order, so by the n-th _END all items have
-                        # been consumed and `pending` has drained
-                        assert not pending, sorted(pending)[:4]
+                        # been consumed and `pending` has drained. Explicit
+                        # raise (not assert): under `python -O` a violated
+                        # invariant must still fail loudly, not silently
+                        # drop the tail of a deterministic eval stream
+                        if pending:
+                            raise RuntimeError(
+                                "imagenet deterministic reorder drain "
+                                f"invariant violated: {len(pending)} "
+                                "item(s) undelivered at stream end, first "
+                                f"seqs {sorted(pending)[:4]} — refusing to "
+                                "silently drop the stream tail")
                         if fill and not is_train:
                             # final partial eval batch: pad + mask
                             mask = np.zeros((batch_size,), np.float32)

@@ -198,10 +198,23 @@ class NativePrefetcher:
         self._final_truncated = 0
         self._lib = load_library()
         arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
-        self._handle = self._lib.drt_prefetch_create(
-            arr, len(paths), num_threads, capacity, int(verify_crc))
-        if not self._handle:
-            raise NativeUnavailable("prefetcher creation failed")
+
+        def create():
+            handle = self._lib.drt_prefetch_create(
+                arr, len(paths), num_threads, capacity, int(verify_crc))
+            if not handle:
+                raise NativeUnavailable("prefetcher creation failed")
+            return handle
+
+        # bounded retry (resilience/retry.py): creation opens every shard,
+        # and a transient FS hiccup there shouldn't abort the whole run —
+        # persistent failure still raises NativeUnavailable for the
+        # documented python fallback
+        from ..resilience.retry import retry_call
+        self._handle = retry_call(
+            create, retries=2, base_delay=0.1,
+            retry_on=(NativeUnavailable,),
+            description="native prefetcher open")
         self._buf = np.empty(1 << 20, np.uint8)  # 1 MB, grown on demand
 
     def __iter__(self) -> Iterator[bytes]:
@@ -256,7 +269,7 @@ class NativePrefetcher:
                 return self._final_truncated
             return self._lib.drt_prefetch_truncated(self._handle)
 
-    def close(self) -> None:
+    def close(self, drain_timeout: float = 5.0) -> None:
         import time
         with self._lock:
             h, self._handle = self._handle, None
@@ -266,10 +279,27 @@ class NativePrefetcher:
         # and decrements _inflight (its properties reads use the local h,
         # still alive until destroy below)
         self._lib.drt_prefetch_stop(h)
+        deadline = time.monotonic() + drain_timeout
         while True:
             with self._lock:
                 if self._inflight == 0:
                     break
+            if time.monotonic() >= deadline:
+                # a missed wakeup in the native layer must not turn
+                # teardown (incl. __del__ at interpreter exit) into an
+                # infinite hang: leak the native object — destroying it
+                # under a live drt_prefetch_next call would be a
+                # use-after-free (ADVICE r5)
+                with self._lock:
+                    inflight = self._inflight
+                log.warning(
+                    "NativePrefetcher.close(): %d in-flight native call(s) "
+                    "did not drain within %.1fs; leaking the native "
+                    "prefetcher handle instead of risking a use-after-free",
+                    inflight, drain_timeout)
+                self._final_crc_errors = self._lib.drt_prefetch_crc_errors(h)
+                self._final_truncated = self._lib.drt_prefetch_truncated(h)
+                return
             time.sleep(0.001)
         self._final_crc_errors = self._lib.drt_prefetch_crc_errors(h)
         self._final_truncated = self._lib.drt_prefetch_truncated(h)

@@ -16,6 +16,7 @@ import logging
 from typing import Dict, Iterator, Optional
 
 from .checkpoint import CheckpointManager, wait_for_new_checkpoint
+from .checkpoint.manager import CheckpointCorrupt
 from .train.loop import Trainer
 from .utils.metrics import MetricsWriter
 
@@ -51,9 +52,11 @@ class Evaluator:
         self.trainer = Trainer(cfg)
         self.trainer.init_state()
         from .utils.config import resolve_checkpoint_dir, stacked_layout_stamp
+        # writer=False: the evaluator READS a directory a live trainer may
+        # be writing — it must not sweep the trainer's in-flight staging dir
         self.manager = CheckpointManager(
             resolve_checkpoint_dir(cfg), max_to_keep=1_000_000,
-            layout_stamp=stacked_layout_stamp(cfg))
+            layout_stamp=stacked_layout_stamp(cfg), writer=False)
         self.writer = writer
         self.best_precision = 0.0   # reference best_precision tracking
         self.last_step: Optional[int] = None
@@ -104,7 +107,17 @@ class Evaluator:
             if step is None:
                 log.info("no new checkpoint; evaluator exiting")
                 return result
-            result = self.evaluate_checkpoint(step)
+            try:
+                result = self.evaluate_checkpoint(step)
+            except (CheckpointCorrupt, FileNotFoundError) as e:
+                # the step was damaged, quarantined by the trainer, or
+                # reaped by retention between our poll and the restore —
+                # a long-running evaluator skips it and keeps polling
+                # rather than dying on exactly the damage the resilience
+                # layer exists to survive (docs/resilience.md)
+                log.warning("skipping checkpoint step %d: %s", step, e)
+                self.last_step = step
+                continue
             n += 1
             if self.cfg.eval.eval_once or (max_evals and n >= max_evals):
                 return result

@@ -24,11 +24,17 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 import signal
 import subprocess
 import sys
 from typing import List
+
+from distributed_resnet_tensorflow_tpu.resilience.preemption import (
+    RESUMABLE_EXIT_CODE)
+
+log = logging.getLogger(__name__)
 
 
 def launch_local(num_processes: int, main_args: List[str],
@@ -62,15 +68,40 @@ def launch_local(num_processes: int, main_args: List[str],
             out = open(f"/tmp/drt_launch/proc{pid}.log", "w")
         procs.append(subprocess.Popen(cmd, env=env, stdout=out, stderr=out))
 
+    # forward SIGTERM (SLURM grace-period kill, kill.sh) to every child so
+    # each commits its preemption checkpoint and exits resumable; the
+    # launcher then reports the children's own exit code
+    def forward_term(signum, frame):
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    try:
+        prev_term = signal.signal(signal.SIGTERM, forward_term)
+    except ValueError:  # not the main thread (embedded use) — no forwarding
+        prev_term = None
     rc = 0
     try:
         for p in procs:
             code = p.wait()
-            rc = rc or code
+            # precedence: real failure > resumable (75) > clean, regardless
+            # of child reap order — a genuinely failing job must never be
+            # masked as merely preempted (the SLURM shim would requeue it)
+            if code != 0 and rc in (0, RESUMABLE_EXIT_CODE):
+                rc = code
     except KeyboardInterrupt:  # kill.sh parity (reference scripts/kill.sh)
         for p in procs:
             p.send_signal(signal.SIGTERM)
         rc = 130
+    finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+    if rc == RESUMABLE_EXIT_CODE:
+        log.warning("children preempted; exit code %d marks the run "
+                    "resumable — relaunch with the same config to resume",
+                    RESUMABLE_EXIT_CODE)
     return rc
 
 

@@ -28,6 +28,11 @@ from .checkpoint import CheckpointManager
 from .data import create_input_iterator
 from .evaluator import Evaluator, make_eval_iterator
 from .parallel import initialize_from_config, is_chief
+from .resilience import Preempted, PreemptionListener, RESUMABLE_EXIT_CODE
+from .resilience.preemption import (collective_preempted,
+                                    collective_should_stop)
+from .resilience.faultinject import maybe_wrap_from_env
+from .resilience.sentinel import train_with_nan_recovery
 from .train.hooks import CheckpointHook, LoggingHook, NanGuardHook, SummaryHook
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
@@ -69,11 +74,14 @@ def _make_train_source(cfg: ExperimentConfig, trainer: Trainer):
     # process_batch_slice)
     from .parallel.mesh import batch_slice_replicated, process_batch_slice
     shard_index, num_shards = process_batch_slice(trainer.mesh)
-    return create_input_iterator(
+    it = create_input_iterator(
         cfg, mode="train", shard_index=shard_index,
         num_shards=num_shards,
         batch_size=_per_process_batch(cfg.train.batch_size, num_shards),
         deterministic=batch_slice_replicated(trainer.mesh))
+    # inert unless the chaos harness armed it via env
+    # (resilience/faultinject.py; tests/test_resilience.py)
+    return maybe_wrap_from_env(it)
 
 
 def _peek(data_iter):
@@ -155,17 +163,26 @@ def _check_resume_config(cfg: ExperimentConfig) -> None:
 
 
 def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
-    """Build → (maybe) restore → train with hooks. Returns (state, metrics)."""
+    """Build → (maybe) restore → train with hooks. Returns (state, metrics).
+
+    Resilience wiring (docs/resilience.md): a PreemptionListener stops the
+    loop at a step boundary on SIGTERM/SIGINT or a config deadline, commits
+    a final checkpoint, and raises Preempted (main() maps it to exit code
+    75); the NaN sentinel rolls back to the last good checkpoint with LR
+    back-off when the guard trips."""
     trainer = Trainer(cfg)
     trainer.init_state()
     _check_resume_config(cfg)
+    res = cfg.resilience
 
     manager = CheckpointManager(
         resolve_checkpoint_dir(cfg), max_to_keep=cfg.checkpoint.max_to_keep,
         save_every_steps=cfg.checkpoint.save_every_steps,
         save_every_secs=cfg.checkpoint.save_every_secs,
         async_save=cfg.checkpoint.async_save,
-        layout_stamp=stacked_layout_stamp(cfg))
+        layout_stamp=stacked_layout_stamp(cfg),
+        verify_on_restore=res.verify_on_restore,
+        io_retries=res.io_retries)
 
     start_step = 0
     if cfg.checkpoint.resume:
@@ -189,7 +206,8 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
             if cfg.train.log_mfu:
                 step_flops = trainer.step_flops(first)
 
-    hooks = [NanGuardHook(every_steps=max(cfg.train.log_every_steps, 1))]
+    guard_every = res.nan_check_every_steps or max(cfg.train.log_every_steps, 1)
+    hooks = [NanGuardHook(every_steps=guard_every)]
     if is_chief():
         hooks.append(LoggingHook(cfg.train.log_every_steps,
                                  batch_size=cfg.train.batch_size,
@@ -198,15 +216,68 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
+    listener = None
+    if res.handle_signals:
+        listener = PreemptionListener(deadline_secs=res.deadline_secs)
+        if not listener.install():
+            listener = None  # not the main thread — run without handlers
+
     num_steps = max_steps if max_steps is not None else cfg.train.train_steps
     try:
-        state, metrics = trainer.train(data_iter, num_steps=num_steps,
-                                       hooks=tuple(hooks),
-                                       start_step=start_step)
+        stop_fn = None
+        if listener is not None:
+            # multi-process: the stop decision must flip at the SAME step
+            # boundary on every process or the SPMD step / save barrier
+            # deadlocks (resilience/preemption.py collective_should_stop)
+            stop_fn = collective_should_stop(listener) \
+                if jax.process_count() > 1 else listener.should_stop
+        if res.nan_max_strikes > 0:
+            def iter_factory(attempt: int):
+                if attempt == 0:
+                    return data_iter
+                # re-seed so the rollback does not replay the exact batch
+                # sequence that blew up (large odd stride keeps the offset
+                # seeds disjoint across attempts)
+                prev_seed = cfg.train.seed
+                cfg.train.seed = prev_seed + 1_000_003 * attempt
+                try:
+                    return _make_train_source(cfg, trainer)
+                finally:
+                    cfg.train.seed = prev_seed
+
+            state, metrics = train_with_nan_recovery(
+                trainer, manager, iter_factory, num_steps=num_steps,
+                hooks=tuple(hooks), start_step=start_step,
+                max_strikes=res.nan_max_strikes,
+                lr_backoff=res.nan_lr_backoff, stop_fn=stop_fn)
+        else:
+            state, metrics = trainer.train(data_iter, num_steps=num_steps,
+                                           hooks=tuple(hooks),
+                                           start_step=start_step,
+                                           stop_fn=stop_fn)
+        # agreed across processes: the save below is collective, so no
+        # process may enter it on a merely-local flag
+        preempted = collective_preempted(listener) \
+            if listener is not None else False
+        if preempted and int(state.step) < num_steps:
+            # a signal landing AFTER the last step finished is not a
+            # preemption — the run is done; exiting 75 would requeue a job
+            # with nothing left to do. Otherwise commit the preemption
+            # checkpoint UNCONDITIONALLY (even when cadence checkpointing
+            # is off): the whole point of a graceful stop is that a
+            # relaunch resumes instead of restarting
+            step = int(state.step)
+            manager.save(step, state, force=True)
+            manager.wait_until_finished()
+            log.warning("preempted (%s): checkpoint committed at step %d; "
+                        "exiting resumable", listener.reason(), step)
+            raise Preempted(step, listener.reason())
         # final checkpoint + drain async saves
         if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
             manager.save(int(state.step), state, force=True)
     finally:
+        if listener is not None:
+            listener.uninstall()
         manager.close()
         if writer is not None:
             # tensorboardX buffers events (~2 min flush window): without
@@ -241,12 +312,19 @@ def run_train_and_eval(cfg: ExperimentConfig):
         save_every_steps=cfg.checkpoint.save_every_steps,
         save_every_secs=cfg.checkpoint.save_every_secs,
         async_save=cfg.checkpoint.async_save,
-        layout_stamp=stacked_layout_stamp(cfg))
+        layout_stamp=stacked_layout_stamp(cfg),
+        verify_on_restore=cfg.resilience.verify_on_restore,
+        io_retries=cfg.resilience.io_retries)
     if cfg.checkpoint.resume:
         trainer.state, _ = manager.restore(trainer.state)
 
     writer = MetricsWriter(os.path.join(cfg.log_root, "train")) if is_chief() else None
-    hooks = [CheckpointHook(manager)]
+    # detection-only NaN guard (raises; the rollback sentinel is a
+    # run_train capability — docs/resilience.md): dying loudly still beats
+    # training and checkpointing NaN state to train_steps
+    guard_every = cfg.resilience.nan_check_every_steps \
+        or max(cfg.train.log_every_steps, 1)
+    hooks = [NanGuardHook(every_steps=guard_every), CheckpointHook(manager)]
     if is_chief():
         hooks.append(LoggingHook(cfg.train.log_every_steps,
                                  batch_size=cfg.train.batch_size,
@@ -256,6 +334,17 @@ def run_train_and_eval(cfg: ExperimentConfig):
 
     train_iter = _make_train_source(cfg, trainer)
 
+    listener = None
+    if cfg.resilience.handle_signals:
+        listener = PreemptionListener(
+            deadline_secs=cfg.resilience.deadline_secs)
+        if not listener.install():
+            listener = None
+    stop_fn = None
+    if listener is not None:
+        stop_fn = collective_should_stop(listener) \
+            if jax.process_count() > 1 else listener.should_stop
+
     every = cfg.train.eval_every_steps or cfg.checkpoint.save_every_steps or 1000
     best = 0.0
     step = int(trainer.state.step)
@@ -264,8 +353,17 @@ def run_train_and_eval(cfg: ExperimentConfig):
         while step < cfg.train.train_steps:
             target = min(step + every, cfg.train.train_steps)
             state, _ = trainer.train(train_iter, num_steps=target,
-                                     hooks=tuple(hooks), start_step=step)
+                                     hooks=tuple(hooks), start_step=step,
+                                     stop_fn=stop_fn)
             step = int(state.step)
+            preempted = collective_preempted(listener) \
+                if listener is not None else False
+            if preempted and step < cfg.train.train_steps:
+                manager.save(step, trainer.state, force=True)
+                manager.wait_until_finished()
+                log.warning("preempted (%s): checkpoint committed at step "
+                            "%d; exiting resumable", listener.reason(), step)
+                raise Preempted(step, listener.reason())
             # fresh iterator per round: the ImageNet eval stream is one-pass
             result = trainer.evaluate(make_eval_iterator(cfg, trainer.mesh),
                                       cfg.eval.eval_batch_count)
@@ -279,6 +377,8 @@ def run_train_and_eval(cfg: ExperimentConfig):
                       f"{result['precision']:.4f} best {best:.4f}")
         manager.save(step, trainer.state, force=True)
     finally:
+        if listener is not None:
+            listener.uninstall()
         manager.close()
         if writer:
             # flush buffered tensorboardX events even on a mid-run error
@@ -300,14 +400,20 @@ def main(argv=None):
     initialize_from_config(cfg.mesh)
     log.info("devices: %d (%d processes)", jax.device_count(),
              jax.process_count())
-    if cfg.mode == "train":
-        run_train(cfg)
-    elif cfg.mode == "eval":
-        run_eval(cfg, timeout_secs=0.0 if cfg.eval.eval_once else 86400.0)
-    elif cfg.mode == "train_and_eval":
-        run_train_and_eval(cfg)
-    else:
-        raise ValueError(f"unknown mode {cfg.mode!r}")
+    try:
+        if cfg.mode == "train":
+            run_train(cfg)
+        elif cfg.mode == "eval":
+            run_eval(cfg, timeout_secs=0.0 if cfg.eval.eval_once else 86400.0)
+        elif cfg.mode == "train_and_eval":
+            run_train_and_eval(cfg)
+        else:
+            raise ValueError(f"unknown mode {cfg.mode!r}")
+    except Preempted as p:
+        # the exit-code contract launchers key off (docs/resilience.md):
+        # 75 = checkpoint committed, relaunch to resume
+        log.info("%s", p)
+        sys.exit(RESUMABLE_EXIT_CODE)
 
 
 if __name__ == "__main__":

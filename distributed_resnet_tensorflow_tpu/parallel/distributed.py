@@ -51,15 +51,48 @@ def initialize(coordinator_address: Optional[str] = None,
                                   os.environ.get("SLURM_NODELIST", ""))
         first = _first_slurm_node(nodelist)
         coordinator_address = f"{first}:8476"
+    from ..resilience.retry import retry_call
+
+    def _preinitialized(e: BaseException) -> bool:
+        # jax spells it "already initialized" in some paths and
+        # "should only be called once" in State.initialize
+        msg = str(e).lower()
+        return "already" in msg or "only be called once" in msg
+
+    def attempt():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+        except Exception as e:
+            # jax assigns its global client BEFORE connect(); without this
+            # reset a retry would die on "should only be called once"
+            # instead of re-attempting the connect (verified against
+            # jax._src.distributed.State.initialize). NEVER shut down a
+            # runtime that was initialized before our call, though — that
+            # would tear down a live cluster connection
+            if not _preinitialized(e):
+                try:
+                    jax.distributed.shutdown()
+                except Exception:  # partially-initialized — best effort
+                    pass
+            raise
+
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
+        # bounded retry: non-chief processes race the coordinator's bind at
+        # job start, and transient DNS/connect failures are routine on big
+        # clusters — the reference's grpc bootstrap just died there
+        retry_call(
+            attempt,
+            retries=3, base_delay=1.0, max_delay=15.0,
+            retry_on=(RuntimeError, ConnectionError, OSError),
+            giveup=_preinitialized,
+            description="jax.distributed.initialize")
         log.info("jax.distributed initialized: process %d/%d @ %s",
                  jax.process_index(), jax.process_count(), coordinator_address)
-    except RuntimeError as e:  # already initialized
-        if "already" not in str(e).lower():
+    except RuntimeError as e:  # already initialized before our call
+        if not _preinitialized(e):
             raise
         log.info("jax.distributed already initialized")
 

@@ -25,8 +25,9 @@ be added without re-architecting — see SURVEY.md §5 "long-context" note):
 """
 from __future__ import annotations
 
-import functools
 import math
+import threading
+import weakref
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -134,7 +135,15 @@ def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
         return shard_map(fn, check_rep=False, **kwargs)
 
 
-@functools.lru_cache(maxsize=32)
+# weak-key memo: an lru_cache here would pin up to maxsize Mesh objects
+# (and their device arrays) for the process lifetime — a real leak in long
+# sessions that build many meshes (tests, notebooks). Weak keys drop an
+# entry the moment its mesh is collected; equal live meshes still share one.
+_batch_slice_cache: "weakref.WeakKeyDictionary[Mesh, Tuple[int, int]]" = \
+    weakref.WeakKeyDictionary()
+_batch_slice_lock = threading.Lock()
+
+
 def process_batch_slice(mesh: Mesh) -> Tuple[int, int]:
     """(input_shard_index, num_input_shards) for THIS process.
 
@@ -147,12 +156,15 @@ def process_batch_slice(mesh: Mesh) -> Tuple[int, int]:
     tests/test_launch.py::test_two_process_pipeline_vit_checkpoint_eval).
     Pure data-over-processes reduces to (process_index, process_count).
 
-    Cached per mesh (lru on the function itself, bounded): the result is
-    a pure function of the mesh, but the computation scans every device
-    coordinate (O(total devices) in Python) and the callers
-    (make_global_batch / make_global_stacked_batch) sit in the per-step
-    input hot path.
+    Memoized per mesh (weak-key, see above): the result is a pure function
+    of the mesh, but the computation scans every device coordinate
+    (O(total devices) in Python) and the callers (make_global_batch /
+    make_global_stacked_batch) sit in the per-step input hot path.
     """
+    with _batch_slice_lock:
+        hit = _batch_slice_cache.get(mesh)
+    if hit is not None:
+        return hit
     pi = jax.process_index()
     arr = mesh.devices
     ax = {name: i for i, name in enumerate(mesh.axis_names)}
@@ -170,7 +182,10 @@ def process_batch_slice(mesh: Mesh) -> Tuple[int, int]:
             f"process {pi}'s devices cover batch shards {sorted(ids)} — "
             "not an aligned contiguous range; choose mesh axis sizes so "
             "each process's batch slice is contiguous")
-    return lo // n, total // n
+    result = (lo // n, total // n)
+    with _batch_slice_lock:
+        _batch_slice_cache[mesh] = result
+    return result
 
 
 def batch_slice_replicated(mesh: Mesh) -> bool:

@@ -1,0 +1,21 @@
+"""Fault-tolerance subsystem: preemption, crash-consistent checkpoints,
+NaN rollback, bounded retries, and the fault-injection harness.
+
+The reference framework assumed a clean world — SLURM restarts on failure
+and ``tf.train.Saver`` hopefully left something usable (SURVEY.md §2.14,
+§4.4). At target scale (ImageNet in minutes over large meshes,
+arXiv:1811.05233 / arXiv:1802.05799) preemptions, torn writes, and loss
+blow-ups are the COMMON case; this package makes each one a handled,
+tested code path. See docs/resilience.md for the protocols and the
+launcher exit-code contract.
+"""
+from .manifest import (  # noqa: F401
+    committed_steps, manifest_status, write_manifest)
+from .preemption import (  # noqa: F401
+    Preempted, PreemptionListener, RESUMABLE_EXIT_CODE)
+from .retry import retry_call  # noqa: F401
+
+# sentinel (and faultinject) are NOT re-exported eagerly: sentinel imports
+# the train stack (and thus jax), and this package is imported by
+# launch.py, which only needs the stdlib-light preemption constants —
+# import from resilience.sentinel / resilience.faultinject directly.

@@ -1,0 +1,181 @@
+"""Fault-injection harness — makes every resilience behavior testable.
+
+Nothing in the reference could SIMULATE a failure; the fault-tolerance story
+was therefore untested by construction (SURVEY.md §4.4). This module is the
+missing chaos tooling, used by tests/test_resilience.py and
+scripts/chaos_smoke.sh:
+
+  * :func:`deliver_signal_after` / :class:`SignalAfter` — deliver a signal
+    to this (or a child) process mid-run from a timer thread.
+  * :func:`corrupt_checkpoint` — tear a COMMITTED checkpoint the way real
+    failures do: truncate the largest payload file (torn write / full disk)
+    or flip a byte in place (bit rot), leaving the manifest stale.
+  * :func:`inject_nan` — wrap a training iterator so the N-th batch carries
+    non-finite pixels, driving a genuine NaN loss through the real model.
+  * :func:`maybe_wrap_from_env` — env-var trigger (``DRT_FAULT_NAN_AT_BATCH``)
+    so subprocess tests and chaos scripts can inject through the unmodified
+    ``main.py`` CLI.
+
+Injection is opt-in and inert by default; none of this runs unless a test or
+operator asks for it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal as _signal
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+NAN_ENV_VAR = "DRT_FAULT_NAN_AT_BATCH"
+
+
+# -- signals ----------------------------------------------------------------
+
+def deliver_signal_after(delay_secs: float, sig: int = _signal.SIGTERM,
+                         pid: Optional[int] = None) -> threading.Timer:
+    """Arm a timer that delivers ``sig`` to ``pid`` (default: this process)
+    after ``delay_secs``. Returns the started Timer (cancel() to disarm)."""
+    target = os.getpid() if pid is None else pid
+
+    def fire():
+        try:
+            os.kill(target, sig)
+        except (ProcessLookupError, PermissionError) as e:
+            log.warning("fault injection: signal %s to pid %d failed: %s",
+                        sig, target, e)
+
+    t = threading.Timer(delay_secs, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+class SignalAfter:
+    """Context manager over :func:`deliver_signal_after` that disarms on
+    exit, so a test that finishes early doesn't shoot the next one."""
+
+    def __init__(self, delay_secs: float, sig: int = _signal.SIGTERM,
+                 pid: Optional[int] = None):
+        self._args = (delay_secs, sig, pid)
+        self._timer: Optional[threading.Timer] = None
+
+    def __enter__(self) -> "SignalAfter":
+        self._timer = deliver_signal_after(*self._args)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+
+
+# -- checkpoint damage ------------------------------------------------------
+
+def _largest_payload(step_dir: str) -> str:
+    from .manifest import MANIFEST_NAME
+    best, best_size = None, -1
+    for dirpath, _dirs, files in os.walk(step_dir):
+        for name in files:
+            if name == MANIFEST_NAME:
+                continue
+            full = os.path.join(dirpath, name)
+            size = os.path.getsize(full)
+            if size > best_size:
+                best, best_size = full, size
+    if best is None:
+        raise FileNotFoundError(f"no payload files under {step_dir}")
+    return best
+
+
+def corrupt_checkpoint(directory: str, step: Optional[int] = None,
+                       mode: str = "truncate") -> int:
+    """Damage a committed checkpoint in place (default: the latest).
+
+    ``mode="truncate"`` drops the second half of the largest payload file —
+    the shape of a torn write; ``mode="flip"`` inverts one byte mid-file
+    with the size unchanged — the shape of bit rot, catchable only by
+    checksum. Returns the damaged step."""
+    from .manifest import committed_steps
+    steps = committed_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no committed checkpoints in {directory}")
+    step = steps[-1] if step is None else step
+    if step not in steps:
+        raise FileNotFoundError(f"step {step} not committed in {directory}")
+    victim = _largest_payload(os.path.join(directory, str(step)))
+    size = os.path.getsize(victim)
+    if mode == "truncate":
+        with open(victim, "r+b") as f:
+            f.truncate(max(0, size // 2))
+    elif mode == "flip":
+        if size == 0:
+            raise ValueError(f"{victim} is empty; nothing to flip")
+        with open(victim, "r+b") as f:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    log.info("fault injection: %s %s (step %d, %d bytes)",
+             mode, victim, step, size)
+    return step
+
+
+# -- NaN loss ---------------------------------------------------------------
+
+def inject_nan(data_iter: Iterator[Dict], at_batch: int,
+               key: str = "images") -> Iterator[Dict]:
+    """Yield batches unchanged except the ``at_batch``-th (1-based), whose
+    ``key`` entry is replaced with NaNs — the loss of that step is then
+    genuinely non-finite through the whole real model/optimizer path.
+
+    Batches without ``key`` (e.g. device-resident ``{"idx"}`` batches) pass
+    through untouched; NaN injection needs the streamed-image path."""
+    if at_batch < 1:
+        raise ValueError(f"at_batch is 1-based, got {at_batch}")
+    count = 0
+    for batch in data_iter:
+        count += 1
+        if count == at_batch and key in batch:
+            poisoned = dict(batch)
+            poisoned[key] = np.full_like(
+                np.asarray(batch[key], dtype=np.float32), np.nan)
+            log.warning("fault injection: batch %d %r poisoned with NaN",
+                        count, key)
+            yield poisoned
+        else:
+            yield batch
+
+
+_nan_armed = False
+
+
+def maybe_wrap_from_env(data_iter: Iterator[Dict],
+                        env: Optional[Dict[str, str]] = None) -> Iterator[Dict]:
+    """Apply :func:`inject_nan` when ``DRT_FAULT_NAN_AT_BATCH`` is set to a
+    positive integer — the hook main.py's train source passes through so
+    subprocess tests / chaos scripts can inject without patching code.
+
+    Arms at most ONCE per process: the NaN sentinel rebuilds the train
+    source after a rollback, and re-poisoning the rebuilt stream would turn
+    one injected fault into an unrecoverable run."""
+    global _nan_armed
+    value = (os.environ if env is None else env).get(NAN_ENV_VAR, "")
+    if not value or _nan_armed:
+        return data_iter
+    _nan_armed = True
+    try:
+        at_batch = int(value)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", NAN_ENV_VAR, value)
+        return data_iter
+    if at_batch < 1:
+        return data_iter
+    log.warning("fault injection armed: NaN images at batch %d (%s)",
+                at_batch, NAN_ENV_VAR)
+    return inject_nan(data_iter, at_batch)

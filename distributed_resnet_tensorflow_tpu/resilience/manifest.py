@@ -1,0 +1,182 @@
+"""Crash-consistent checkpoint commit protocol: manifest + fsync + rename.
+
+A checkpoint directory is COMMITTED iff it is named by its bare step number
+(``<dir>/<step>/``). Writers stage into ``<dir>/_staging.<step>/``, write a
+``MANIFEST.json`` listing every payload file with its size and SHA-256,
+fsync the manifest and the staging dir, then ``os.replace`` the staging dir
+onto the final name — a single atomic rename on POSIX. A crash at any point
+leaves either no ``<step>/`` entry at all (stale staging dirs are swept on
+the next manager construction) or a fully-written one; readers
+(``CheckpointManager.restore``, the evaluator's ``wait_for_new_checkpoint``)
+never observe a torn checkpoint under its committed name.
+
+The manifest additionally lets ``restore()`` detect payload damage that
+happened AFTER commit (truncation by a full disk, bit rot, a partial rsync)
+and fall back to the newest older checkpoint that still verifies, instead of
+crashing — the reference's ``tf.train.Saver`` trusted latest_checkpoint
+blindly (SURVEY.md §2.14).
+
+Checkpoints written before this protocol existed (plain orbax
+``CheckpointManager`` layout) carry no manifest; they verify as ``"legacy"``
+— accepted, with a log line that integrity can't be proven.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Dict, List, Tuple
+
+log = logging.getLogger(__name__)
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_FORMAT = 1
+_STAGING_PREFIX = "_staging."
+
+
+def staging_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"{_STAGING_PREFIX}{step}")
+
+
+def is_staging_name(name: str) -> bool:
+    return name.startswith(_STAGING_PREFIX)
+
+
+def fsync_dir(path: str) -> None:
+    """Durably record directory-entry changes (the rename itself)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platform without dir fds — best effort
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def file_sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _payload_files(step_dir: str) -> List[str]:
+    """Every regular file under ``step_dir`` except the manifest itself,
+    as sorted relative paths."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(step_dir):
+        for name in filenames:
+            rel = os.path.relpath(os.path.join(dirpath, name), step_dir)
+            if rel != MANIFEST_NAME:
+                out.append(rel)
+    return sorted(out)
+
+
+def write_manifest(step_dir: str, step: int) -> Dict:
+    """Checksum every payload file and durably write ``MANIFEST.json``
+    inside ``step_dir`` (fsync file, then fsync the dir so the entry itself
+    is on disk before the commit rename)."""
+    files = {}
+    for rel in _payload_files(step_dir):
+        full = os.path.join(step_dir, rel)
+        # fsync every payload file BEFORE the manifest: the serializer
+        # (orbax) does not fsync, so without this the hash below describes
+        # page-cache contents — power loss after the commit rename could
+        # leave a committed step whose payload never reached disk
+        fd = os.open(full, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        files[rel] = {"size": os.path.getsize(full),
+                      "sha256": file_sha256(full)}
+    manifest = {"format": MANIFEST_FORMAT, "step": step, "files": files}
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    fsync_dir(step_dir)
+    return manifest
+
+
+def manifest_status(step_dir: str) -> Tuple[str, str]:
+    """Verify a committed checkpoint dir against its manifest.
+
+    Returns ``("ok", "")`` when every listed file exists with matching size
+    and SHA-256 and no extra payload appeared; ``("legacy", ...)`` when no
+    manifest exists (pre-protocol checkpoint — integrity unprovable but not
+    known-bad); ``("bad", reason)`` on any mismatch.
+    """
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return "legacy", "no manifest (written before the commit protocol)"
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+        # extra payload (partial rsync debris, concurrent-writer leftovers)
+        # is damage too: orbax may trip over it long after we said "ok"
+        extra = sorted(set(_payload_files(step_dir)) - set(files))
+        if extra:
+            return "bad", f"unlisted payload file(s): {extra[:4]}"
+        for rel, meta in files.items():
+            full = os.path.join(step_dir, rel)
+            if not os.path.exists(full):
+                return "bad", f"missing payload file {rel}"
+            size = os.path.getsize(full)
+            if size != meta.get("size"):
+                return "bad", (f"size mismatch in {rel}: "
+                               f"{size} != {meta.get('size')}")
+            # size check first: the common torn write (truncation) is
+            # caught without reading the file; the hash catches in-place
+            # corruption
+            if file_sha256(full) != meta.get("sha256"):
+                return "bad", f"checksum mismatch in {rel}"
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        # also covers the dir vanishing mid-verification (another process
+        # quarantined it on a shared FS) — that ranks as damaged here and
+        # the caller falls back, instead of crashing the whole restore
+        return "bad", f"unreadable checkpoint/manifest: {e}"
+    return "ok", ""
+
+
+def committed_steps(directory: str) -> List[int]:
+    """Steps with a COMMITTED checkpoint dir (bare-numeric name), sorted
+    ascending. Staging dirs, orbax tmp dirs (``<step>.orbax-checkpoint-
+    tmp-*``), and sidecar files never match."""
+    try:
+        names = os.listdir(directory)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    return sorted(int(n) for n in names
+                  if n.isdigit() and os.path.isdir(os.path.join(directory, n)))
+
+
+def sweep_staging(directory: str) -> int:
+    """Remove leftover staging dirs from a crashed writer. Returns the
+    number removed. Call only when no other writer can be live (manager
+    construction)."""
+    import shutil
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except (FileNotFoundError, NotADirectoryError):
+        return 0
+    for name in names:
+        full = os.path.join(directory, name)
+        if is_staging_name(name) and os.path.isdir(full):
+            shutil.rmtree(full, ignore_errors=True)
+            removed += 1
+    if removed:
+        log.info("swept %d stale checkpoint staging dir(s) in %s",
+                 removed, directory)
+    return removed

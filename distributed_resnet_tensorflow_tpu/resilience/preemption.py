@@ -1,0 +1,237 @@
+"""Preemption handling: signal listener + resumable-exit contract.
+
+The reference's answer to a SLURM preemption was SIGKILL-after-grace with
+whatever checkpoint ``save_checkpoint_secs`` last happened to write — up to
+10 minutes of lost work on the ImageNet cadence (SURVEY.md §2.14). Here the
+train loop polls a :class:`PreemptionListener` at step boundaries; on
+SIGTERM/SIGINT (or an optional wall-clock deadline for maintenance-window
+preemption) it stops cleanly, ``main.run_train`` force-commits a final
+checkpoint, and the process exits with :data:`RESUMABLE_EXIT_CODE` so
+launchers (launch.py, scripts/submit_tpu_slurm.sh) know to requeue rather
+than fail the job.
+
+Exit-code contract (docs/resilience.md):
+  0   — finished train_steps; nothing to resume.
+  75  — preempted; a checkpoint at the last finished step is committed and
+        a relaunch with the same config resumes exactly there (EX_TEMPFAIL,
+        the sysexits "temporary failure, retry" code).
+  else — a real error.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import time
+from typing import Iterable, Optional
+
+log = logging.getLogger(__name__)
+
+#: sysexits.h EX_TEMPFAIL — "temporary failure; user is invited to retry".
+RESUMABLE_EXIT_CODE = 75
+
+_DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class Preempted(Exception):
+    """Raised by run_train after a graceful preemption stop; carries the
+    step whose checkpoint was committed. main() maps it to
+    RESUMABLE_EXIT_CODE."""
+
+    def __init__(self, step: int, reason: str = "signal"):
+        super().__init__(f"preempted ({reason}) at step {step}; "
+                         f"checkpoint committed — resumable")
+        self.step = step
+        self.reason = reason
+
+
+class PreemptionListener:
+    """Installable SIGTERM/SIGINT flag + optional deadline.
+
+    The handler only sets a flag (async-signal-safe); the train loop polls
+    ``should_stop()`` at step boundaries, so the stop always lands between
+    optimizer steps with a consistent TrainState. A second signal while a
+    stop is already pending restores the previous handler and re-delivers,
+    so a stuck drain can still be killed the ordinary way.
+    """
+
+    #: window (secs) in which a repeated signal counts as DUPLICATE
+    #: delivery, not operator escalation: terminals and SLURM signal the
+    #: whole process group, so a launcher forwarding SIGTERM hands every
+    #: child a second copy milliseconds after the first — escalating on
+    #: that would kill the child before its preemption checkpoint commits
+    ESCALATION_GRACE_SECS = 1.0
+
+    def __init__(self, signals: Iterable[int] = _DEFAULT_SIGNALS,
+                 deadline_secs: float = 0.0):
+        self._signals = tuple(signals)
+        self._deadline = (time.monotonic() + deadline_secs
+                          if deadline_secs > 0 else None)
+        self._event = threading.Event()
+        self._reason: Optional[str] = None
+        self._first_signal_time: Optional[float] = None
+        self._prev = {}
+        self._installed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def install(self) -> bool:
+        """Install handlers. Returns False (listener inert) when not on the
+        main thread — ``signal.signal`` only works there, and an inert
+        listener beats breaking library callers (e.g. tests driving
+        run_train from a worker thread)."""
+        if self._installed:
+            return True
+        try:
+            for sig in self._signals:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+        except ValueError:  # not the main thread
+            for sig, prev in self._prev.items():
+                signal.signal(sig, prev)
+            self._prev.clear()
+            log.warning("PreemptionListener: not on the main thread; "
+                        "signal handling disabled for this run")
+            return False
+        self._installed = True
+        return True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # pragma: no cover
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionListener":
+        self.install()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- signal path -------------------------------------------------------
+    def _on_signal(self, signum, frame) -> None:
+        first = not self._event.is_set()
+        self._reason = self._reason or f"signal {signal.Signals(signum).name}"
+        self._event.set()
+        if first:
+            self._first_signal_time = time.monotonic()
+            # logging from a signal handler is not strictly re-entrant, but
+            # this fires once and the alternative (silence) costs operators
+            # real debugging time on every preemption
+            log.warning("%s received: finishing the current step, "
+                        "committing a checkpoint, exiting resumable (%d)",
+                        signal.Signals(signum).name, RESUMABLE_EXIT_CODE)
+            return
+        # a repeat within the grace window is duplicate delivery (process
+        # group + forwarding launcher), not an operator asking twice
+        if self._first_signal_time is not None and \
+                time.monotonic() - self._first_signal_time \
+                < self.ESCALATION_GRACE_SECS:
+            return
+        # second signal: restore the previous disposition and re-deliver so
+        # the default action (terminate / KeyboardInterrupt) happens now.
+        # ``prev`` is None when the pre-existing handler wasn't installed
+        # from Python (C extension, embedding launcher) — signal.signal
+        # would TypeError on it, leaving the process gracefully unkillable;
+        # fall back to the default disposition instead
+        prev = self._prev.get(signum)
+        if prev is None:
+            prev = signal.SIG_DFL
+        try:
+            signal.signal(signum, prev)
+        except TypeError:  # pragma: no cover - exotic prev handler object
+            signal.signal(signum, signal.SIG_DFL)
+        signal.raise_signal(signum)
+
+    # -- polling API (train-loop hot path: one Event.is_set + a clock read) -
+    def should_stop(self) -> bool:
+        if self._event.is_set():
+            return True
+        if self._deadline is not None and time.monotonic() >= self._deadline:
+            if self._reason is None:
+                self._reason = "deadline"
+                log.warning("preemption deadline reached: stopping at the "
+                            "next step boundary")
+            self._event.set()
+            return True
+        return False
+
+    def preempted(self) -> bool:
+        """True once a stop was requested (signal or deadline)."""
+        return self.should_stop()
+
+    def reason(self) -> str:
+        return self._reason or "not preempted"
+
+
+def collective_preempted(listener: PreemptionListener) -> bool:
+    """One-shot cross-process OR of ``preempted()``.
+
+    The post-train decision to enter the preemption save must be AGREED:
+    the save is itself a collective (sharded write + commit barrier), so a
+    process entering it on a local-only flag — deadline clock skew, or an
+    early return (input exhaustion) between the throttled in-loop sync
+    points — would hang on peers that skipped it. Call from ALL processes
+    at the same program point; single-process reduces to the local flag.
+    """
+    import jax
+    if jax.process_count() <= 1:
+        return listener.preempted()
+    import numpy as np
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([listener.preempted()], dtype=np.bool_))
+    agreed = bool(np.any(flags))
+    if agreed:
+        if listener._reason is None:
+            listener._reason = "peer preempted"
+        listener._event.set()
+    return agreed
+
+
+def collective_should_stop(listener: PreemptionListener,
+                           sync_every: int = 8):
+    """Cross-process stop agreement for multi-host runs.
+
+    Per-process stop flags are a deadlock hazard: signal delivery skew (or
+    clock skew on the deadline) can make process 0 stop after step N while
+    process 1 runs on — its next collective step then hangs waiting for a
+    participant that left, and the final checkpoint save barriers on
+    mismatched step names. The flags are therefore all-gathered and ORed,
+    so (a) a signal landing on ANY process stops all of them and (b) the
+    decision flips at the SAME poll everywhere — every process polls at
+    identical loop points of the same SPMD program.
+
+    The host collective is paid only on every ``sync_every``-th poll (the
+    poll COUNT is identical across processes, so the throttle cannot
+    desync them); in between, the poll is the local Event check only.
+    Preemption reaction latency grows by at most sync_every-1 steps —
+    irrelevant against a SLURM grace period — while fast-step multi-host
+    runs don't serialize every step on a cross-host round-trip.
+    """
+    import numpy as np
+    calls = {"n": 0, "stopped": False}
+
+    def should_stop() -> bool:
+        if calls["stopped"]:
+            return True
+        local = listener.should_stop()
+        calls["n"] += 1
+        if calls["n"] % sync_every:
+            return False  # between sync points nobody stops unilaterally
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([local], dtype=np.bool_))
+        agreed = bool(np.any(flags))
+        if agreed:
+            calls["stopped"] = True
+            if not local and listener._reason is None:
+                listener._reason = "peer preempted"
+            listener._event.set()  # mirror: preempted()/reason() stay true
+        return agreed
+
+    return should_stop

@@ -1,0 +1,66 @@
+"""Bounded retry with exponential backoff + jitter.
+
+The reference had no retry anywhere: a flaky NFS stat during a checkpoint
+save or a coordinator that came up a second late killed the whole SLURM job
+(SURVEY.md §4.4 — failure handling was "SLURM restarts everything"). At the
+scales this framework targets (hundreds of hosts, "Massively Distributed
+SGD" arXiv:1811.05233) transient faults are the common case, so the I/O and
+bootstrap edges — distributed init (parallel/distributed.py), checkpoint
+reads/writes (checkpoint/manager.py), native-loader opens
+(data/native_loader.py) — route through this one bounded helper instead of
+each growing an ad-hoc sleep loop.
+
+Deliberately dependency-free and cheap to import.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+log = logging.getLogger(__name__)
+
+
+def retry_call(fn: Callable, *args,
+               retries: int = 3,
+               base_delay: float = 0.2,
+               max_delay: float = 5.0,
+               jitter: float = 0.5,
+               retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+               giveup: Optional[Callable[[BaseException], bool]] = None,
+               description: str = "",
+               sleep: Callable[[float], None] = time.sleep,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)``; on a ``retry_on`` exception, back off
+    exponentially (``base_delay * 2**attempt``, capped at ``max_delay``,
+    ±``jitter`` fraction randomized so a fleet of hosts doesn't retry in
+    lockstep) and try again, at most ``retries`` extra times.
+
+    ``giveup(exc) -> True`` marks an exception permanent (re-raised
+    immediately without burning retries) — e.g. "already initialized" from
+    ``jax.distributed``. The final failure re-raises the original exception
+    unchanged so callers' except clauses keep working.
+    """
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    what = description or getattr(fn, "__name__", repr(fn))
+    for attempt in range(retries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if giveup is not None and giveup(e):
+                raise
+            if attempt >= retries:
+                log.warning("%s failed after %d attempt(s): %s",
+                            what, retries + 1, e)
+                raise
+            delay = min(max_delay, base_delay * (2 ** attempt))
+            delay *= 1.0 + jitter * (2.0 * random.random() - 1.0)
+            delay = max(0.0, delay)
+            log.warning("%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                        what, attempt + 1, retries + 1, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+

@@ -1,0 +1,92 @@
+"""NaN/Inf sentinel: rollback + LR back-off instead of a dead run.
+
+The reference's only divergence story was a human noticing ``loss = nan`` in
+the 20-step log while the cluster kept burning node-hours (SURVEY.md §4.4).
+round-0 added detection (train/hooks.py NanGuardHook raises); this module
+adds RECOVERY: when the guard trips, roll the TrainState back to the last
+good committed checkpoint, re-seed the data stream (so the exact batch
+sequence that blew up is not replayed), shrink the LR schedule by a
+configurable back-off factor, and keep training — giving up loudly after
+``max_strikes`` rollbacks so a genuinely broken run still fails.
+
+Large-batch recipes hit transient loss spikes / non-finite steps routinely
+(LARS at bs=32k, arXiv:1811.05233 §4 discusses exactly this class of
+instability); a bounded automatic retry converts "page the operator" into a
+log line.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterator, Optional, Tuple
+
+from ..train.hooks import NanGuardHook
+
+log = logging.getLogger(__name__)
+
+
+class TooManyNanRetries(RuntimeError):
+    """The run kept producing non-finite loss after every allowed rollback."""
+
+
+def train_with_nan_recovery(
+        trainer, manager,
+        iter_factory: Callable[[int], Iterator],
+        num_steps: Optional[int],
+        hooks: Tuple = (),
+        start_step: int = 0,
+        *,
+        max_strikes: int = 3,
+        lr_backoff: float = 0.5,
+        stop_fn: Optional[Callable[[], bool]] = None):
+    """``trainer.train`` wrapped in the rollback-retry policy.
+
+    ``iter_factory(attempt)`` builds the training stream; attempt 0 is the
+    original run, attempt N>0 follows the N-th rollback and must re-seed /
+    re-offset the stream. The guard raises out of ``trainer.train`` (hooks
+    run at step boundaries); recovery restores the newest checkpoint that
+    verifies (checkpoint/manager.py fallback order), multiplies the LR
+    schedule by ``lr_backoff**strikes``, and resumes from the restored step
+    — or from a fresh init at step 0 when nothing was ever committed.
+
+    NOTE the window: a checkpoint saved between the non-finite step and the
+    guard's next check would itself be poisoned, so keep the guard cadence
+    (resilience.nan_check_every_steps) at or below the save cadence.
+    """
+    strikes = 0
+    data_iter = iter_factory(0)
+    step = start_step
+    while True:
+        try:
+            return trainer.train(data_iter, num_steps=num_steps,
+                                 hooks=hooks, start_step=step,
+                                 stop_fn=stop_fn)
+        except NanGuardHook.NanLossError as e:
+            strikes += 1
+            if strikes > max_strikes:
+                raise TooManyNanRetries(
+                    f"non-finite loss persisted through {max_strikes} "
+                    f"rollback(s) with LR backed off to "
+                    f"{lr_backoff ** max_strikes:g}x — giving up: {e}"
+                ) from e
+            trainer.state, restored = manager.restore(trainer.state)
+            if restored is None:
+                # nothing ever committed: restart from a fresh init
+                trainer.init_state()
+                step = 0
+            else:
+                step = int(trainer.state.step)
+            # rewind every hook's cadence to the restored step: a guard
+            # whose _last still points at the trip step would be blind for
+            # the whole replayed span — long enough for a cadence save to
+            # commit NaN params with a valid manifest
+            for h in hooks:
+                rollback = getattr(h, "rollback_to", None)
+                if rollback is not None:
+                    rollback(step)
+            scale = lr_backoff ** strikes
+            trainer.scale_lr(scale)
+            data_iter = iter_factory(strikes)
+            log.warning(
+                "NaN sentinel strike %d/%d: %s — rolled back to step %d, "
+                "LR scaled to %gx, data stream re-seeded (attempt %d)",
+                strikes, max_strikes, e, step, scale, strikes)

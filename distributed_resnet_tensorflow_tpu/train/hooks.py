@@ -26,7 +26,37 @@ log = logging.getLogger(__name__)
 from ..utils import cadence_crossed  # noqa: F401  (re-export; shared impl)
 
 
-class LoggingHook:
+def nonfinite_metric(metrics: Optional[Dict[str, Any]]) -> Optional[str]:
+    """The first divergence-indicator key ("loss", "grad_norm") whose value
+    is non-finite, else None. ONE definition shared by NanGuardHook (the
+    detector) and CheckpointHook (the save gate) — the pair must agree or a
+    cadence save could commit the very state the guard is about to flag.
+    Calling this forces a device sync (float()); gate on cadence first."""
+    import math
+    if not metrics:
+        return None
+    for key in ("loss", "grad_norm"):
+        value = metrics.get(key)
+        if value is not None and not math.isfinite(float(value)):
+            return key
+    return None
+
+
+class _CadenceHook:
+    """Shared cadence cursor for hooks gating on ``cadence_crossed``."""
+
+    _last = 0
+
+    def rollback_to(self, step: int) -> None:
+        """Rewind the cadence after a checkpoint rollback
+        (resilience/sentinel.py): a cursor still pointing at the trip step
+        would treat every replayed step as already-handled — for the NaN
+        guard that is a blind window in which a cadence save could commit
+        NaN params; for logging/summaries the replayed span would vanish."""
+        self._last = min(self._last, step)
+
+
+class LoggingHook(_CadenceHook):
     """Print step/loss/precision/lr every N steps + throughput (reference
     LoggingTensorHook cadence: 20 cifar / 40 imagenet,
     resnet_cifar_main.py:280-285)."""
@@ -67,7 +97,7 @@ class LoggingHook:
         self.print_fn("  ".join(parts))
 
 
-class SummaryHook:
+class SummaryHook(_CadenceHook):
     """Write scalars to the MetricsWriter every N steps (reference
     SummarySaverHook every 100, resnet_cifar_main.py:274-278)."""
 
@@ -86,16 +116,33 @@ class SummaryHook:
 
 
 class CheckpointHook:
-    """Save via CheckpointManager on its step/time policy."""
+    """Save via CheckpointManager on its step/time policy.
+
+    Refuses to checkpoint a visibly non-finite state: with time-based
+    cadence the save timer can fire between a loss blow-up and the NaN
+    guard's next check, and a committed NaN checkpoint (valid manifest!)
+    would then be what every rollback restores — defeating the recovery in
+    resilience/sentinel.py. The finite check runs only when the cadence
+    actually fires, so the hot path pays no device sync."""
 
     def __init__(self, manager):
         self.manager = manager
 
     def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        # gate first so the finite check (a device sync via float()) is
+        # paid only when the cadence actually fires
+        should = getattr(self.manager, "should_save", None)
+        if should is not None and not should(step):
+            return
+        bad = nonfinite_metric(metrics)
+        if bad is not None:
+            log.warning("skipping checkpoint at step %d: non-finite %s "
+                        "(the NaN guard will handle recovery)", step, bad)
+            return
         self.manager.maybe_save(step, state)
 
 
-class NanGuardHook:
+class NanGuardHook(_CadenceHook):
     """Abort (or callback) on non-finite loss — active divergence detection.
 
     The reference's only guard was a human watching the 20-step loss log
@@ -115,10 +162,15 @@ class NanGuardHook:
         if not cadence_crossed(step, self.every_steps, self._last):
             return
         self._last = step
-        loss = float(metrics.get("loss", 0.0))
-        if loss != loss or loss in (float("inf"), float("-inf")):
+        # loss AND grad_norm (nonfinite_metric): an exploding gradient
+        # shows up in grad_norm a step before the loss goes non-finite
+        # (the optimizer has already eaten the inf update by then) —
+        # catching either is the trigger for the rollback policy in
+        # resilience/sentinel.py
+        bad = nonfinite_metric(metrics)
+        if bad is not None:
             if self.on_nan is not None:
                 self.on_nan(step, metrics)
                 return
             raise self.NanLossError(
-                f"non-finite loss {loss} at step {step}")
+                f"non-finite {bad} {float(metrics[bad])} at step {step}")

@@ -526,14 +526,41 @@ class Trainer:
         sh = NamedSharding(self.mesh, P(None, *data_sharding(self.mesh).spec))
         return jax.device_put(batch, {"idx": sh})
 
+    # -- resilience --------------------------------------------------------
+    def scale_lr(self, scale: float) -> None:
+        """Rebuild the LR schedule multiplied by ``scale`` and invalidate
+        the jitted steps — the NaN sentinel's back-off knob
+        (resilience/sentinel.py). Costs one recompile on the recovery path;
+        the hot path is untouched at scale 1. The live TrainState's
+        optimizer is swapped too (tx is a static field, so replace() keeps
+        the restored pytree leaves)."""
+        base = create_schedule(self.cfg.optimizer)
+        self.schedule = base if scale == 1.0 else \
+            (lambda step: base(step) * scale)
+        self.tx = create_optimizer(self.cfg.optimizer, self.schedule)
+        self._train_step = self._build_train_step(self._aug_fn)
+        self._jitted_train = None
+        self._jitted_multi = None
+        self._jitted_idx = None
+        self._jitted_idx_multi = None
+        if self.state is not None:
+            self.state = self.state.replace(tx=self.tx)
+
     # -- loops -------------------------------------------------------------
     def train(self, data_iter: Iterator, num_steps: Optional[int] = None,
-              hooks: Tuple = (), start_step: int = 0):
+              hooks: Tuple = (), start_step: int = 0,
+              stop_fn: Optional[Callable[[], bool]] = None):
         """The hot loop (reference resnet_cifar_main.py:336-337).
 
         With ``train.steps_per_loop > 1``, K steps run inside one XLA
         dispatch (lax.scan); hooks fire at loop boundaries with the last
         step's metrics.
+
+        ``stop_fn`` is polled at step/loop boundaries (after hooks): when it
+        returns True the loop returns immediately with the state as of the
+        last finished step — the preemption listener's entry point
+        (resilience/preemption.py). The poll is one Event check; it does not
+        force a device sync.
         """
         if self.state is None:
             self.init_state()
@@ -593,6 +620,8 @@ class Trainer:
                 self.state, metrics = step_fn(self.state, batch)
                 for h in hooks:
                     h(step + 1, self.state, metrics)
+                if stop_fn is not None and stop_fn():
+                    return self.state, metrics
             return self.state, metrics
 
         multi_fn = self.jitted_index_multi_step(k) if use_idx \
@@ -621,27 +650,37 @@ class Trainer:
                 else self.jitted_train_step()
 
         def run_singles(stacked, offset, count):
+            """Returns the number of steps actually run (a stop_fn stop may
+            cut it short; the caller's remainder bookkeeping must not drop
+            the unconsumed batches)."""
             nonlocal step, metrics
             step_fn = single_fn()
             for i in range(offset, offset + count):
+                if stop_fn is not None and stop_fn():
+                    return i - offset
                 b = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
                 self.state, metrics = step_fn(self.state, b)
                 step += 1
                 for h in hooks:
                     h(step, self.state, metrics)
+            return count
 
         # 1) consume a previous tail's remainder, one step at a time
         if entry[2] is not None and step < num_steps:
             stacked, offset = entry[2]
             take = min(k - offset, num_steps - step)
-            run_singles(stacked, offset, take)
-            offset += take
+            done = run_singles(stacked, offset, take)
+            offset += done
             entry[2] = None if offset >= k else [stacked, offset]
+            if done < take:  # stop_fn fired mid-remainder
+                return self.state, metrics
         # 2) fused full groups. A finite stream that exhausts ends training
         # early — the reference's serial path had the same stop condition
         # (input exhaustion, SURVEY.md §3.5); train streams here repeat
         # forever, so this only triggers for deliberately truncated inputs.
         while step + k <= num_steps:
+            if stop_fn is not None and stop_fn():
+                return self.state, metrics
             try:
                 stacked = next(stacked_iter)
             except StopIteration:
@@ -660,8 +699,8 @@ class Trainer:
             except StopIteration:
                 return self.state, metrics
             take = num_steps - step
-            run_singles(stacked, 0, take)
-            entry[2] = [stacked, take]
+            done = run_singles(stacked, 0, take)
+            entry[2] = [stacked, done] if done < k else None
         return self.state, metrics
 
     def evaluate(self, data_iter: Iterator, num_batches: int) -> Dict[str, float]:

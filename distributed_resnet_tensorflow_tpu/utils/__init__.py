@@ -7,6 +7,7 @@ from .config import (  # noqa: F401
     ModelConfig,
     OptimizerConfig,
     PRESETS,
+    ResilienceConfig,
     TrainConfig,
     get_preset,
     parse_args,

@@ -201,6 +201,35 @@ class CheckpointConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault-tolerance knobs (resilience/ subsystem; docs/resilience.md).
+    The reference had none of this — failure handling was "SLURM restarts
+    the job" (SURVEY.md §4.4)."""
+
+    # SIGTERM/SIGINT → finish the step, commit a checkpoint, exit with the
+    # resumable code (75) so launchers requeue instead of failing
+    handle_signals: bool = True
+    # > 0: stop resumable after this many seconds even without a signal —
+    # maintenance-window / max-walltime preemption (set it slightly under
+    # the SLURM time limit so the final checkpoint beats the SIGKILL)
+    deadline_secs: float = 0.0
+    # NaN/Inf sentinel: on non-finite loss/grad-norm, roll back to the last
+    # good checkpoint, re-seed the data stream, retry with the LR scaled by
+    # backoff**strikes; give up loudly after max_strikes rollbacks.
+    # 0 strikes = detection only (the guard raises, run dies — old behavior)
+    nan_max_strikes: int = 3
+    nan_lr_backoff: float = 0.5
+    # guard cadence; 0 = follow train.log_every_steps. Keep at or below the
+    # checkpoint cadence, else a save can land between blow-up and detection
+    nan_check_every_steps: int = 0
+    # verify checkpoint manifests (size + sha256 per file) before restoring;
+    # damaged checkpoints are skipped in favor of the newest valid one
+    verify_on_restore: bool = True
+    # bounded-retry policy for checkpoint I/O (resilience/retry.py)
+    io_retries: int = 3
+
+
+@dataclass
 class EvalConfig:
     """Standalone polling evaluator (reference resnet_cifar_eval.py:85-141)."""
 
@@ -226,6 +255,7 @@ class ExperimentConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
     mode: str = "train"               # train | eval | train_and_eval
     log_root: str = "/tmp/drt_tpu"    # reference log_root flag

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Chaos smoke — run the fault-injection suite (resilience/faultinject.py):
+# signal delivery mid-run, torn/bit-rotted checkpoints, injected NaN loss.
+# Everything runs on the fake-CPU mesh (tests/conftest.py) — no accelerator
+# needed. It is the same set tier-1 runs (`-m "not slow"`); note that set
+# INCLUDES the @heavy SIGTERM kill-and-resume subprocess test (~1-2 min of
+# real training subprocesses on a 1-core host). For a seconds-fast pass,
+# add `-m "not slow and not heavy"`.
+#
+#   scripts/chaos_smoke.sh            # the tier-1 chaos set (incl. heavy)
+#   scripts/chaos_smoke.sh -k nan     # just the NaN-recovery cases
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+  -m "not slow" -p no:cacheprovider "$@"

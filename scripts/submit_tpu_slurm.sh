@@ -21,12 +21,40 @@ LOG_ROOT="${LOG_ROOT:-logs/${SLURM_JOB_NAME:-drt}-${SLURM_JOB_ID:-local}}"
 mkdir -p "$LOG_ROOT"
 
 # reference parity: optional checkpoint wipe via FRESH=1
-# (reference submit_cifar_daint_dist.sh:67-73)
-if [[ "${FRESH:-0}" == "1" ]]; then
+# (reference submit_cifar_daint_dist.sh:67-73). Guarded by
+# SLURM_RESTART_COUNT: a requeue after preemption re-runs this script with
+# the ORIGINAL submission environment (FRESH=1 included) — wiping then
+# would delete the preemption checkpoint the requeue exists to resume from
+if [[ "${FRESH:-0}" == "1" && "${SLURM_RESTART_COUNT:-0}" == "0" ]]; then
   rm -rf "$LOG_ROOT/ckpt"
 fi
 
+# Exit-code contract (docs/resilience.md): 75 (EX_TEMPFAIL) means the run
+# was preempted gracefully — a checkpoint at the last finished step is
+# committed and a relaunch with the same config resumes there. Requeue the
+# job instead of failing it; any other nonzero code is a real error.
+set +e
 srun --no-kill python -m distributed_resnet_tensorflow_tpu.main \
   --preset "$PRESET" \
   --set "log_root=$LOG_ROOT" \
   "$@"
+rc=$?
+set -e
+
+if [[ $rc -eq 75 ]]; then
+  # CAVEAT: srun reports the HIGHEST task exit code, so one task failing
+  # with a small code (e.g. 1) while peers exit 75 is masked as "preempted"
+  # — MAX_REQUEUES bounds the damage if that job is genuinely broken
+  if [[ "${SLURM_RESTART_COUNT:-0}" -ge "${MAX_REQUEUES:-20}" ]]; then
+    echo "exit 75 but MAX_REQUEUES (${MAX_REQUEUES:-20}) reached; failing"
+    exit 1
+  fi
+  echo "run preempted (exit 75): checkpoint committed, requeueing for resume"
+  if [[ -n "${SLURM_JOB_ID:-}" ]] && scontrol requeue "$SLURM_JOB_ID"; then
+    exit 0
+  fi
+  # outside SLURM (or requeue refused): surface the resumable code so a
+  # wrapper loop can relaunch
+  exit 75
+fi
+exit $rc

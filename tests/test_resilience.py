@@ -1,0 +1,468 @@
+"""Fault-injection suite for the resilience subsystem (docs/resilience.md):
+preemption signals, crash-consistent checkpoint commit/fallback, NaN
+rollback + LR back-off, bounded retries. Run standalone via
+scripts/chaos_smoke.sh; everything here is tier-1 (CPU fake mesh)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_resnet_tensorflow_tpu.checkpoint import (
+    CheckpointManager, wait_for_new_checkpoint)
+from distributed_resnet_tensorflow_tpu.checkpoint.manager import (
+    CheckpointCorrupt)
+from distributed_resnet_tensorflow_tpu.data import learnable_synthetic_iterator
+from distributed_resnet_tensorflow_tpu.resilience import (
+    Preempted, PreemptionListener, RESUMABLE_EXIT_CODE,
+    committed_steps, retry_call)
+from distributed_resnet_tensorflow_tpu.resilience import faultinject
+from distributed_resnet_tensorflow_tpu.resilience.sentinel import (
+    TooManyNanRetries, train_with_nan_recovery)
+from distributed_resnet_tensorflow_tpu.resilience.manifest import (
+    manifest_status)
+from distributed_resnet_tensorflow_tpu.train import Trainer
+from distributed_resnet_tensorflow_tpu.train.hooks import NanGuardHook
+from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+
+# ---------------------------------------------------------------------------
+# retry.py
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, retries=3, base_delay=0.0,
+                      sleep=lambda s: None) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_bounded_and_reraises_original():
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        retry_call(always_down, retries=2, base_delay=0.0,
+                   sleep=lambda s: None)
+    assert len(calls) == 3  # 1 original + 2 retries, no more
+
+
+def test_retry_giveup_short_circuits_permanent_errors():
+    calls = []
+
+    def already():
+        calls.append(1)
+        raise RuntimeError("coordinator already initialized")
+
+    with pytest.raises(RuntimeError):
+        retry_call(already, retries=5, base_delay=0.0,
+                   retry_on=(RuntimeError,),
+                   giveup=lambda e: "already" in str(e),
+                   sleep=lambda s: None)
+    assert len(calls) == 1  # permanent: no retries burned
+
+
+# ---------------------------------------------------------------------------
+# preemption.py
+# ---------------------------------------------------------------------------
+
+def test_preemption_listener_flags_sigterm_and_restores_handler():
+    prev = signal.getsignal(signal.SIGTERM)
+    listener = PreemptionListener()
+    assert listener.install()
+    try:
+        assert not listener.should_stop()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not listener.should_stop() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert listener.preempted()
+        assert "SIGTERM" in listener.reason()
+    finally:
+        listener.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_preemption_deadline():
+    listener = PreemptionListener(signals=(), deadline_secs=0.05)
+    with listener:
+        assert not listener.preempted() or True  # may legally be False yet
+        time.sleep(0.06)
+        assert listener.should_stop()
+        assert listener.reason() == "deadline"
+
+
+# ---------------------------------------------------------------------------
+# commit protocol + restore fallback (no model compile: minimal state)
+# ---------------------------------------------------------------------------
+
+class _State:
+    """Minimal TrainState-like object for CheckpointManager."""
+
+    def __init__(self, v: float):
+        self.step = int(v)
+        self.params = {"w": np.full(256, float(v), np.float32)}
+        self.batch_stats = {}
+        self.opt_state = {}
+
+    def replace(self, **kw):
+        out = _State(0)
+        out.__dict__.update(self.__dict__)
+        out.__dict__.update(kw)
+        return out
+
+
+def _fill(state) -> float:
+    return float(np.asarray(state.params["w"])[0])
+
+
+def test_commit_protocol_manifest_and_no_staging(tmp_path):
+    d = str(tmp_path / "c")
+    m = CheckpointManager(d, async_save=False)
+    m.save(1, _State(1))
+    assert m.all_steps() == [1]
+    # committed layout: bare-numeric dir, verified manifest, no staging left
+    assert manifest_status(os.path.join(d, "1")) == ("ok", "")
+    assert not [n for n in os.listdir(d) if n.startswith("_staging")]
+    # the evaluator's poll primitive sees the committed step...
+    assert wait_for_new_checkpoint(d, None, timeout_secs=0.0) == 1
+    # ...but never a staging dir
+    os.makedirs(os.path.join(d, "_staging.9"))
+    assert wait_for_new_checkpoint(d, 1, timeout_secs=0.0) is None
+    m.close()
+
+
+def test_torn_latest_falls_back_to_previous_valid(tmp_path):
+    d = str(tmp_path / "c")
+    m = CheckpointManager(d, async_save=False)
+    for s in (1, 2, 3):
+        m.save(s, _State(s))
+    faultinject.corrupt_checkpoint(d, mode="truncate")  # tears step 3
+    st, step = m.restore(_State(0))
+    assert step == 2 and _fill(st) == 2.0
+    # the damaged dir is quarantined so a re-trained step 3 can commit
+    assert committed_steps(d) == [1, 2]
+    assert os.path.isdir(os.path.join(d, "3.corrupt"))
+    m.save(3, _State(33))  # re-commit after rollback must not be blocked
+    st, step = m.restore(_State(0))
+    assert step == 3 and _fill(st) == 33.0
+    m.close()
+
+
+def test_bitflip_detected_by_checksum(tmp_path):
+    """Same size, one byte flipped — only the SHA-256 can catch this."""
+    d = str(tmp_path / "c")
+    m = CheckpointManager(d, async_save=False)
+    m.save(1, _State(1))
+    m.save(2, _State(2))
+    faultinject.corrupt_checkpoint(d, step=2, mode="flip")
+    status, detail = manifest_status(os.path.join(d, "2"))
+    assert status == "bad" and "checksum" in detail
+    st, step = m.restore(_State(0))
+    assert step == 1 and _fill(st) == 1.0
+    m.close()
+
+
+def test_explicitly_requested_corrupt_step_raises(tmp_path):
+    d = str(tmp_path / "c")
+    m = CheckpointManager(d, async_save=False)
+    m.save(1, _State(1))
+    m.save(2, _State(2))
+    faultinject.corrupt_checkpoint(d, step=2, mode="truncate")
+    with pytest.raises(CheckpointCorrupt):
+        m.restore(_State(0), step=2)
+    m.close()
+
+
+def test_all_checkpoints_corrupt_refuses_fresh_start(tmp_path):
+    d = str(tmp_path / "c")
+    m = CheckpointManager(d, async_save=False)
+    m.save(1, _State(1))
+    m.save(2, _State(2))
+    faultinject.corrupt_checkpoint(d, step=1, mode="flip")
+    faultinject.corrupt_checkpoint(d, step=2, mode="truncate")
+    with pytest.raises(CheckpointCorrupt, match="refusing"):
+        m.restore(_State(0))
+    m.close()
+
+
+def test_legacy_checkpoint_without_manifest_restores(tmp_path):
+    d = str(tmp_path / "c")
+    m = CheckpointManager(d, async_save=False)
+    m.save(1, _State(1))
+    os.remove(os.path.join(d, "1", "MANIFEST.json"))
+    m2 = CheckpointManager(d, async_save=False)
+    st, step = m2.restore(_State(0))
+    assert step == 1 and _fill(st) == 1.0
+    m.close(); m2.close()
+
+
+def test_async_save_commits_retains_and_sweeps(tmp_path):
+    d = str(tmp_path / "c")
+    os.makedirs(os.path.join(d, "_staging.7"))  # crashed-writer leftover
+    m = CheckpointManager(d, async_save=True, max_to_keep=2)
+    assert not os.path.isdir(os.path.join(d, "_staging.7"))  # swept at init
+    for s in (1, 2, 3):
+        m.save(s, _State(s))
+    m.wait_until_finished()
+    assert m.all_steps() == [2, 3]  # retention applied
+    st, step = m.restore(_State(0))
+    assert step == 3 and _fill(st) == 3.0
+    m.close()
+
+
+# ---------------------------------------------------------------------------
+# NaN sentinel (real Trainer, logistic model for compile speed)
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg(tmp_path):
+    cfg = get_preset("smoke")
+    cfg.model.name = "logistic"
+    cfg.model.input_size = 192  # 8*8*3
+    cfg.model.hidden_units = 32
+    cfg.model.num_classes = 4
+    cfg.model.compute_dtype = "float32"
+    cfg.data.image_size = 8
+    cfg.train.batch_size = 16
+    cfg.train.log_every_steps = 1
+    cfg.optimizer.schedule = "constant"
+    cfg.optimizer.learning_rate = 0.05
+    cfg.log_root = str(tmp_path)
+    cfg.checkpoint.directory = os.path.join(str(tmp_path), "ckpt")
+    cfg.checkpoint.async_save = False
+    return cfg
+
+
+def test_nan_guard_checks_grad_norm_too():
+    h = NanGuardHook(every_steps=1)
+    h(1, None, {"loss": 1.0, "grad_norm": 2.0})  # finite: no raise
+    with pytest.raises(NanGuardHook.NanLossError, match="grad_norm"):
+        h(2, None, {"loss": 1.0, "grad_norm": float("inf")})
+
+
+def test_nan_sentinel_rolls_back_backs_off_and_recovers(tmp_path):
+    cfg = _tiny_cfg(tmp_path)
+    tr = Trainer(cfg)
+    tr.init_state()
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False)
+    state, _ = tr.train(learnable_synthetic_iterator(16, 8, 4), num_steps=5)
+    mngr.save(5, state)
+    base_lr = float(tr.schedule(0))
+
+    def factory(attempt):
+        if attempt == 0:  # 3rd batch after resume (step 8) goes NaN
+            return faultinject.inject_nan(
+                learnable_synthetic_iterator(16, 8, 4, seed=1), at_batch=3)
+        return learnable_synthetic_iterator(16, 8, 4, seed=10 + attempt)
+
+    guard = NanGuardHook(every_steps=1)
+    state, metrics = train_with_nan_recovery(
+        tr, mngr, factory, num_steps=20, hooks=(guard,), start_step=5,
+        max_strikes=2, lr_backoff=0.5)
+    # the run converged to the target step despite the injected NaN...
+    assert int(state.step) == 20
+    assert np.isfinite(float(metrics["loss"]))
+    import jax
+    leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(state.params)]
+    assert all(np.isfinite(l).all() for l in leaves)
+    # ...after exactly one rollback with the LR backed off 0.5x
+    assert float(tr.schedule(0)) == pytest.approx(0.5 * base_lr)
+    mngr.close()
+
+
+def test_nan_sentinel_gives_up_after_max_strikes(tmp_path):
+    cfg = _tiny_cfg(tmp_path)
+    tr = Trainer(cfg)
+    tr.init_state()
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False)
+
+    def factory(attempt):  # every attempt is poisoned immediately
+        return faultinject.inject_nan(
+            learnable_synthetic_iterator(16, 8, 4, seed=attempt), at_batch=1)
+
+    guard = NanGuardHook(every_steps=1)
+    with pytest.raises(TooManyNanRetries):
+        train_with_nan_recovery(tr, mngr, factory, num_steps=10,
+                                hooks=(guard,), max_strikes=2, lr_backoff=0.5)
+    mngr.close()
+
+
+# ---------------------------------------------------------------------------
+# stop_fn + run_train preemption wiring
+# ---------------------------------------------------------------------------
+
+def test_trainer_stop_fn_stops_at_step_boundary(tmp_path):
+    cfg = _tiny_cfg(tmp_path)
+    tr = Trainer(cfg)
+    tr.init_state()
+    seen = []
+
+    def hook(step, state, metrics):
+        seen.append(step)
+
+    state, _ = tr.train(learnable_synthetic_iterator(16, 8, 4),
+                        num_steps=50, hooks=(hook,),
+                        stop_fn=lambda: len(seen) >= 3)
+    assert int(state.step) == 3
+    assert seen == [1, 2, 3]  # no extra steps after the stop
+
+
+def test_run_train_deadline_preempts_commits_and_resumes(tmp_path):
+    """The in-process analog of a maintenance-window preemption: run_train
+    under a deadline stops at a step boundary, commits a checkpoint, and
+    raises Preempted; a relaunch resumes from exactly that step."""
+    from distributed_resnet_tensorflow_tpu.main import run_train
+    cfg = _tiny_cfg(tmp_path)
+    cfg.train.train_steps = 100000  # unbounded-ish: only the deadline stops it
+    cfg.checkpoint.save_every_steps = 100000  # no cadence save before preempt
+    cfg.checkpoint.save_every_secs = 0.0
+    cfg.resilience.deadline_secs = 1.0  # elapses during/after compile
+    with pytest.raises(Preempted):
+        run_train(cfg)
+    steps = committed_steps(cfg.checkpoint.directory)
+    assert steps, "preemption must commit a checkpoint even off-cadence"
+    assert manifest_status(
+        os.path.join(cfg.checkpoint.directory, str(steps[-1])))[0] == "ok"
+
+    cfg2 = _tiny_cfg(tmp_path)
+    cfg2.train.train_steps = steps[-1] + 5
+    cfg2.resilience.deadline_secs = 0.0
+    state, _ = run_train(cfg2)
+    assert int(state.step) == steps[-1] + 5
+
+
+def test_evaluator_skips_damaged_checkpoint(tmp_path):
+    """A long-running polling evaluator must skip a checkpoint that gets
+    damaged (or quarantined/reaped) between poll and restore, not die —
+    that damage is exactly what the resilience layer exists to survive."""
+    from distributed_resnet_tensorflow_tpu.evaluator import Evaluator
+    cfg = _tiny_cfg(tmp_path)
+    cfg.eval.eval_batch_count = 1
+    tr = Trainer(cfg)
+    tr.init_state()
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False)
+    state, _ = tr.train(learnable_synthetic_iterator(16, 8, 4), num_steps=2)
+    mngr.save(2, state)
+    mngr.close()
+    faultinject.corrupt_checkpoint(cfg.checkpoint.directory, step=2,
+                                   mode="flip")
+    ev = Evaluator(cfg, data_iter=learnable_synthetic_iterator(16, 8, 4))
+    out = ev.run(timeout_secs=0.0)  # must not raise
+    assert out == {}            # nothing evaluable existed...
+    assert ev.last_step == 2    # ...but the damaged step was consumed/skipped
+
+
+def test_env_nan_injection_hook(monkeypatch):
+    batches = [{"images": np.ones((2, 2), np.float32),
+                "labels": np.zeros((2,), np.int32)} for _ in range(3)]
+    monkeypatch.setenv(faultinject.NAN_ENV_VAR, "2")
+    monkeypatch.setattr(faultinject, "_nan_armed", False)
+    wrapped = faultinject.maybe_wrap_from_env(iter(batches))
+    out = [next(wrapped) for _ in range(3)]
+    assert np.isfinite(out[0]["images"]).all()
+    assert np.isnan(out[1]["images"]).all()
+    assert np.isfinite(out[2]["images"]).all()
+    # second wrap in the same process stays clean (sentinel retry contract)
+    wrapped2 = faultinject.maybe_wrap_from_env(iter(batches))
+    assert all(np.isfinite(next(wrapped2)["images"]).all() for _ in range(3))
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume: SIGTERM a real main.py run mid-way (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.heavy
+def test_sigterm_kill_and_resume_exact_continuation(tmp_path):
+    """SIGTERM a live trainer: it must exit with the resumable code (75)
+    leaving a committed checkpoint at its stop step; the relaunch must reach
+    the target with a contiguous, monotonic metrics stream — no duplicated
+    or skipped steps across the preemption boundary."""
+    from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
+        virtual_cpu_env)
+
+    ckpt_dir = os.path.join(str(tmp_path), "ckpt")
+    args = [
+        sys.executable, "-m", "distributed_resnet_tensorflow_tpu.main",
+        "--preset", "smoke",
+        "--set", "model.name=logistic",
+        "--set", "model.input_size=192",
+        "--set", "model.hidden_units=800",  # slow the step a little
+        "--set", "model.num_classes=10",
+        "--set", "data.image_size=8",
+        "--set", "train.batch_size=8",
+        "--set", "train.log_every_steps=1000",
+        "--set", "train.summary_every_steps=1",  # JSONL row per step
+        "--set", f"log_root={tmp_path}",
+        "--set", "checkpoint.save_every_steps=100000",  # only preempt saves
+        "--set", "checkpoint.save_every_secs=0",
+    ]
+    env = virtual_cpu_env(1)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    jsonl = os.path.join(str(tmp_path), "train", "metrics.jsonl")
+
+    def metric_steps():
+        try:
+            with open(jsonl) as f:
+                return [json.loads(l)["step"] for l in f if l.strip()]
+        except FileNotFoundError:
+            return []
+
+    # run 1: unbounded-ish; SIGTERM once a few steps are on record
+    p = subprocess.Popen(args + ["--set", "train.train_steps=1000000"],
+                         env=env, cwd=repo,
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if len(metric_steps()) >= 3:
+                break
+            if p.poll() is not None:
+                raise AssertionError("trainer exited before it was killed")
+            time.sleep(0.1)
+        else:
+            raise AssertionError("no metrics appeared before the deadline")
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=120)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert rc == RESUMABLE_EXIT_CODE, rc  # the launcher contract
+
+    steps = committed_steps(ckpt_dir)
+    assert steps, "graceful preemption must leave a committed checkpoint"
+    preempt = steps[-1]
+    rows_run1 = metric_steps()
+    # the checkpoint is at the exact last finished (and logged) step, and
+    # it passes verification — committed, not torn
+    assert preempt == rows_run1[-1], (preempt, rows_run1[-6:])
+    assert manifest_status(os.path.join(ckpt_dir, str(preempt)))[0] == "ok"
+
+    # run 2: resume to a bounded target
+    target = preempt + 15
+    rc2 = subprocess.run(
+        args + ["--set", f"train.train_steps={target}"], env=env, cwd=repo,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        timeout=600).returncode
+    assert rc2 == 0
+    all_rows = metric_steps()
+    resumed = all_rows[len(rows_run1):]
+    # exact continuation: preempt+1 ... target, nothing skipped or repeated
+    assert resumed == list(range(preempt + 1, target + 1)), resumed[:5]
+    # and the combined stream is strictly monotonic across the boundary
+    assert all_rows == sorted(set(all_rows)), "metrics stream not monotonic"
