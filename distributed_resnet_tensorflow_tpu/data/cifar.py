@@ -22,9 +22,12 @@ cifar_input.py:66-75). Eval: standardization only.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+from ..utils.metrics import input_stages
 
 IMAGE_SIZE = 32
 DEPTH = 3
@@ -175,15 +178,18 @@ def cifar_iterator(dataset: str, data_dir: str, batch_size: int, mode: str,
                     # silently skipped tail images (resnet_cifar_eval.py ran
                     # fixed 50x100 batches over a 10k test set)
                     pad = batch_size - len(idx)
-                    idx = np.concatenate([idx, np.zeros(pad, np.int64)])
+                    idx = np.concatenate([idx, np.zeros(pad, idx.dtype)])
                     mask = np.concatenate([np.ones(batch_size - pad, np.float32),
                                            np.zeros(pad, np.float32)])
                 else:
                     mask = None
+                t0 = time.perf_counter()
                 batch_imgs = images[idx]
                 if is_train and device_augment:
                     out = {"images": batch_imgs,  # raw uint8; device augments
                            "labels": labels[idx].copy()}
+                    input_stages.add("decode", time.perf_counter() - t0,
+                                     items=batch_size)
                     yield out
                     continue
                 if is_train:
@@ -192,6 +198,10 @@ def cifar_iterator(dataset: str, data_dir: str, batch_size: int, mode: str,
                        "labels": labels[idx].copy()}
                 if mask is not None:
                     out["mask"] = mask
+                # host-side parse/augment/standardize busy time (the cifar
+                # analog of the imagenet decode stage)
+                input_stages.add("decode", time.perf_counter() - t0,
+                                 items=batch_size)
                 yield out
 
     if prefetch > 0 and is_train:

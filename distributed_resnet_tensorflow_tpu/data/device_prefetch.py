@@ -4,13 +4,23 @@ The reference's analog was tf.data's prefetch buffering and the 16-thread
 queue runners (reference resnet_cifar_main.py:232, cifar_input.py:77-96).
 Here:
 
-  * ``device_prefetch``   — keep ``depth`` host→device transfers in flight
-    behind compute (JAX transfers are asynchronous).
+  * ``device_prefetch``   — a DEDICATED transfer thread runs the host→device
+    placement fn and feeds a bounded queue of already-device-resident
+    batches, so decode, stacking, H2D transfer and dispatch each own a
+    thread and run concurrently. (The pre-overlap version dispatched
+    transfers inline on the consumer thread — staging was serial with
+    dispatch, which is exactly the "serial staging" bottleneck BENCH_r05
+    measured.)
   * ``threaded_iterator`` — run ANY iterator on a background thread with a
     bounded queue; the single implementation of the worker/stop/error
     machinery used by every threaded input stage.
   * ``threaded_stacker``  — draw K batches + np.stack on a background thread
     (the input side of the fused ``steps_per_loop`` dispatch).
+
+Every stage records busy time + item counts into
+``utils.metrics.input_stages`` (stages: decode / stack / stage / transfer /
+dispatch_wait — see docs/input_pipeline.md), so attribution of the
+end-to-end input rate comes from the pipeline as it actually ran.
 
 All returned generators stop their worker thread when closed — a replaced
 or abandoned pipeline must not leave a thread parked on its queue holding
@@ -18,40 +28,122 @@ batches.
 """
 from __future__ import annotations
 
-import collections
+import logging
 import queue as queue_mod
 import threading
-from typing import Callable, Iterator
+import time
+from typing import Callable, Iterator, Optional
+
+log = logging.getLogger(__name__)
+
+
+def _batch_items(batch) -> int:
+    """Number of examples a host batch carries (for stage-rate counters):
+    the label leaf's element count covers both flat (B,) and stacked (K, B)
+    batches; index batches ({"idx"}) count indices."""
+    try:
+        for key in ("labels", "idx"):
+            leaf = batch.get(key) if hasattr(batch, "get") else None
+            if leaf is not None:
+                return int(getattr(leaf, "size", len(leaf)))
+        leaf = next(iter(batch.values()))
+        return int(leaf.shape[0])
+    except Exception:
+        return 0
 
 
 def device_prefetch(host_iter: Iterator, put: Callable, depth: int = 2
                     ) -> Iterator:
-    """Yield device-resident batches with ``depth`` transfers in flight.
+    """Yield device-resident batches staged by a dedicated transfer thread.
 
-    ``put`` is the host→device placement fn (e.g. Trainer._put_batch). The
-    queue keeps ``depth`` batches already dispatched; pulling one immediately
-    dispatches the next, so transfers run behind compute.
+    ``put`` is the host→device placement fn (e.g. Trainer._put_batch). It
+    runs on its own thread: while the consumer dispatches step N, the
+    transfer thread is already staging batches N+1.. into a bounded queue
+    of ``depth`` device-resident batches, with one more transfer kept in
+    flight behind the current ``put`` call. A slow ``put`` therefore never
+    blocks the consumer while staged batches remain queued.
+
+    A put returning a ``StagedBatch`` (the coalesced stager) is finalized
+    on the CONSUMER thread: the staging thread then only moves data, and
+    every multi-device XLA execution (unpack + step) is dispatched from
+    one thread — launching them from two threads interleaves per-device
+    enqueue order and can deadlock against a collective-bearing step.
+
+    Closing the returned generator stops the transfer thread and propagates
+    close() to ``host_iter`` (so upstream worker threads shut down too).
     """
-    queue: collections.deque = collections.deque()
-    try:
-        try:
-            for _ in range(depth):
-                queue.append(put(next(host_iter)))
-        except StopIteration:
-            pass
-        while queue:
-            out = queue.popleft()
+    import jax
+
+    from ..utils.metrics import input_stages
+
+    # a put that records its own stage counters (CoalescedStager splits
+    # pack → "stage" and issue → "transfer") must not have its items
+    # double-counted; we then only charge the completion wait
+    put_records = getattr(put, "records_stages", False)
+
+    def staged():
+        # Batches are yielded the moment their transfer is ISSUED (jax
+        # arrays are futures — the consumer's dispatch does not need them
+        # materialized), so a put() blocked on batch N never withholds an
+        # already-issued batch from the consumer. Before issuing N+1 the
+        # thread waits for N's transfer to complete: that keeps exactly one
+        # transfer in flight behind the current put AND makes the
+        # "transfer" counter reflect true H2D throughput (issue alone is
+        # async and near-free).
+        prev = None  # (device_batch, items, issue_seconds)
+
+        def charge(entry):
+            dev, items, issue_s = entry
+            t0 = time.perf_counter()
             try:
-                queue.append(put(next(host_iter)))
-            except StopIteration:
-                pass
-            yield out
-    finally:
-        # propagate close() (e.g. Trainer replacing its cached prefetcher)
-        # down to the source so worker threads shut down
-        close = getattr(host_iter, "close", None)
-        if close is not None:
-            close()
+                # StagedBatch exposes block_until_ready (transfer only);
+                # plain pytrees block leaf-wise
+                blocker = getattr(dev, "block_until_ready", None)
+                if blocker is not None:
+                    blocker()
+                else:
+                    jax.block_until_ready(dev)
+            except Exception:
+                pass  # non-jax payloads (tests stub put with plain values)
+            wait_s = time.perf_counter() - t0
+            if put_records:
+                input_stages.add("transfer", wait_s)
+            else:
+                input_stages.add("transfer", issue_s + wait_s, items=items)
+
+        try:
+            for batch in host_iter:
+                items = _batch_items(batch)
+                t0 = time.perf_counter()
+                out = put(batch)
+                issue_s = time.perf_counter() - t0
+                if prev is not None:
+                    charge(prev)
+                prev = (out, items, issue_s)
+                yield out
+            if prev is not None:
+                charge(prev)
+        finally:
+            # propagate close() (e.g. Trainer replacing its cached
+            # prefetcher) down to the source so worker threads shut down
+            close = getattr(host_iter, "close", None)
+            if close is not None:
+                close()
+
+    inner = threaded_iterator(staged(), depth, name="drt-device-stage",
+                              wait_stage="dispatch_wait")
+
+    def finalized():
+        # runs on the CONSUMER thread: resolve StagedBatch handles into
+        # their leaf pytrees (an async multi-device dispatch, ~µs)
+        try:
+            for item in inner:
+                fin = getattr(item, "finalize", None)
+                yield fin() if fin is not None else item
+        finally:
+            inner.close()
+
+    return finalized()
 
 
 class _WorkerError:
@@ -63,16 +155,23 @@ _STOP = object()
 
 
 def threaded_iterator(src: Iterator, depth: int = 2,
-                      name: str = "drt-input-worker") -> Iterator:
+                      name: str = "drt-input-worker",
+                      wait_stage: Optional[str] = None) -> Iterator:
     """Run ``src`` on a daemon thread feeding a bounded queue of ``depth``.
 
     Worker exceptions re-raise on the consuming thread; closing the returned
     generator (or GC'ing it) sets a stop event that EVERY queue put honors —
     including the terminal sentinel/error puts — so the thread can never
     park forever on a full queue.
+
+    ``wait_stage``: when set, consumer time spent blocked on an empty queue
+    is recorded under that stage name in ``utils.metrics.input_stages``
+    (the dispatch-wait counter: how long input made the consumer wait).
     """
     q: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
     stop = threading.Event()
+    if wait_stage is not None:
+        from ..utils.metrics import input_stages
 
     def put_checked(item) -> bool:
         while not stop.is_set():
@@ -100,7 +199,13 @@ def threaded_iterator(src: Iterator, depth: int = 2,
     thread.start()
     try:
         while True:
-            item = q.get()
+            if wait_stage is None:
+                item = q.get()
+            else:
+                t0 = time.perf_counter()
+                item = q.get()
+                input_stages.add(wait_stage, time.perf_counter() - t0,
+                                 items=1)
             if item is _STOP:
                 return
             if isinstance(item, _WorkerError):
@@ -138,19 +243,33 @@ def threaded_stacker(host_iter: Iterator, k: int, depth: int = 2) -> Iterator:
     (Trainer.jitted_multi_step): the K-batch draw + stack is real host work
     (decode, memcpy) that would otherwise sit between scan dispatches; a
     bounded queue of ``depth`` pre-stacked loops keeps the dispatch thread
-    hot. Iterator exhaustion ends the stream cleanly (a trailing partial
-    group of < k batches is dropped); closing the returned generator stops
-    the worker thread.
+    hot. Iterator exhaustion ends the stream cleanly; a trailing partial
+    group of < k batches cannot be dispatched as a fused loop and is
+    dropped — logged once at stream end, never silently (the no-silent-caps
+    rule). Closing the returned generator stops the worker thread.
     """
     import numpy as np
 
+    from ..utils.metrics import input_stages
+
     def groups():
         while True:
+            batches = []
             try:
-                batches = [next(host_iter) for _ in range(k)]
+                for _ in range(k):
+                    batches.append(next(host_iter))
             except StopIteration:
+                if batches:
+                    log.warning(
+                        "threaded_stacker: dropping %d trailing batch(es) "
+                        "at stream end (shorter than the k=%d fused-loop "
+                        "group)", len(batches), k)
                 return
-            yield {key: np.stack([b[key] for b in batches])
+            t0 = time.perf_counter()
+            out = {key: np.stack([b[key] for b in batches])
                    for key in batches[0]}
+            input_stages.add("stack", time.perf_counter() - t0,
+                             items=_batch_items(out))
+            yield out
 
     return threaded_iterator(groups(), depth, name="drt-batch-stacker")

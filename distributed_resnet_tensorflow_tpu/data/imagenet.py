@@ -31,6 +31,7 @@ import glob
 import os
 import queue as queue_mod
 import threading
+import time
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np
@@ -391,7 +392,12 @@ def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
     from .preprocessing import (RGB_MEANS, eval_crop_from_bytes,
                                 train_crop_from_bytes)
     import queue as queue_mod
+
+    from ..utils.metrics import input_stages
     wrng = np.random.RandomState(wseed)
+    # decode counters flush in small groups: an input_stages.add per image
+    # would contend the registry lock across the whole decode pool
+    pend_n = pend_s = pend_b = 0
 
     def put_checked(item) -> bool:
         """Timed put in thread mode so `stop` is observed even on a FULL
@@ -409,40 +415,56 @@ def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
                 continue
         return False
 
-    while stop is None or not stop.is_set():
-        # timed get in thread mode so `stop` is observed between items: an
-        # abandoned iterator (eval warmup, a polling evaluator sized below
-        # the dataset) sets `stop` while workers sit in get(); a blocking
-        # get would strand num_decode_threads daemon threads per iterator,
-        # growing unboundedly in a long-lived poll loop.
-        try:
-            item = in_q.get(timeout=None if stop is None else 0.2)
-        except queue_mod.Empty:
-            continue
-        if item is _END or isinstance(item, _EndMarker):
-            put_checked(_END)
-            return
-        if deterministic:
-            # per-item RNG from the sample's sequence number: the same
-            # record gets the same augmentation no matter which worker
-            # decodes it (see imagenet_iterator's `deterministic`)
-            seq, (data, label) = item
-            rng = np.random.RandomState((wseed + 2654435761 * seq)
-                                        % (2 ** 32))
-        else:
-            seq, (data, label) = None, item
-            rng = wrng
-        if is_train:
-            img = train_crop_from_bytes(data, rng, image_size,
-                                        use_native=native_decode)
-        else:
-            img = eval_crop_from_bytes(data, image_size,
-                                       use_native=native_decode)
-        if not emit_uint8:
-            img = img.astype(np.float32) / 255.0 - RGB_MEANS
-        out = (img, label) if seq is None else (seq, (img, label))
-        if not put_checked(out):
-            return
+    try:
+        while stop is None or not stop.is_set():
+            # timed get in thread mode so `stop` is observed between
+            # items: an abandoned iterator (eval warmup, a polling
+            # evaluator sized below the dataset) sets `stop` while workers
+            # sit in get(); a blocking get would strand
+            # num_decode_threads daemon threads per iterator, growing
+            # unboundedly in a long-lived poll loop.
+            try:
+                item = in_q.get(timeout=None if stop is None else 0.2)
+            except queue_mod.Empty:
+                continue
+            if item is _END or isinstance(item, _EndMarker):
+                put_checked(_END)
+                return
+            if deterministic:
+                # per-item RNG from the sample's sequence number: the same
+                # record gets the same augmentation no matter which worker
+                # decodes it (see imagenet_iterator's `deterministic`)
+                seq, (data, label) = item
+                rng = np.random.RandomState((wseed + 2654435761 * seq)
+                                            % (2 ** 32))
+            else:
+                seq, (data, label) = None, item
+                rng = wrng
+            t0 = time.perf_counter()
+            if is_train:
+                img = train_crop_from_bytes(data, rng, image_size,
+                                            use_native=native_decode)
+            else:
+                img = eval_crop_from_bytes(data, image_size,
+                                           use_native=native_decode)
+            if not emit_uint8:
+                img = img.astype(np.float32) / 255.0 - RGB_MEANS
+            # decode busy time (stage counters, utils/metrics.py) — worker
+            # PROCESSES report into their own process's registry, so only
+            # thread-mode decode is visible here (docs/input_pipeline.md)
+            pend_n += 1
+            pend_s += time.perf_counter() - t0
+            pend_b += img.nbytes
+            if pend_n >= 16:
+                input_stages.add("decode", pend_s, items=pend_n,
+                                 nbytes=pend_b)
+                pend_n = pend_s = pend_b = 0
+            out = (img, label) if seq is None else (seq, (img, label))
+            if not put_checked(out):
+                return
+    finally:
+        if pend_n:
+            input_stages.add("decode", pend_s, items=pend_n, nbytes=pend_b)
 
 
 def _decode_worker(in_q, out_q, wseed, is_train, image_size, native_decode,

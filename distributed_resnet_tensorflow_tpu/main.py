@@ -33,7 +33,8 @@ from .resilience.preemption import (collective_preempted,
                                     collective_should_stop)
 from .resilience.faultinject import maybe_wrap_from_env
 from .resilience.sentinel import train_with_nan_recovery
-from .train.hooks import CheckpointHook, LoggingHook, NanGuardHook, SummaryHook
+from .train.hooks import (CheckpointHook, InputStagesHook, LoggingHook,
+                          NanGuardHook, SummaryHook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
                            resolve_checkpoint_dir, stacked_layout_stamp)
@@ -213,6 +214,8 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
                                  batch_size=cfg.train.batch_size,
                                  print_fn=print, step_flops=step_flops))
         hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
+        # input-pipeline stage attribution rides the summary cadence
+        hooks.append(InputStagesHook(writer, cfg.train.summary_every_steps))
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
@@ -331,6 +334,8 @@ def run_train_and_eval(cfg: ExperimentConfig):
                                  print_fn=print))
         if writer:
             hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
+            hooks.append(InputStagesHook(writer,
+                                         cfg.train.summary_every_steps))
 
     train_iter = _make_train_source(cfg, trainer)
 

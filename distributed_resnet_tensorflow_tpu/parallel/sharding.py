@@ -9,11 +9,38 @@ all-gather/reduce-scatter instead of grpc push/pull.
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+import threading
+import time
+import weakref
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def coerce_batch_dtypes(batch: Any) -> Any:
+    """Narrow platform-default 64-bit leaves before the host→device hop.
+
+    Labels/indices arrive int64 whenever they pass through a numpy op that
+    defaults to the platform int (np.arange/np.concatenate on mixed inputs,
+    a user-supplied list), and jax silently ships the 8-byte payload —
+    doubling label transfer bytes for data the model reads as int32 anyway
+    (x64 is off; jax would truncate AFTER the transfer). One shared
+    coercion, applied by every put path (shard_batch / make_global_batch /
+    the coalesced stager): integer leaves → int32, float64 → float32.
+    """
+    def fix(x):
+        dt = getattr(x, "dtype", None)
+        if dt is None:
+            return x
+        if dt == np.int64:
+            return np.asarray(x, np.int32)
+        if dt == np.float64:
+            return np.asarray(x, np.float32)
+        return x
+
+    return jax.tree_util.tree_map(fix, batch)
 
 
 def stacked_encoder_spec(leaf_name: str, ndim: int, tensor: int = 1) -> P:
@@ -184,7 +211,7 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
     from .mesh import data_sharding
     sharding = data_sharding(mesh)
     return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), batch)
+        lambda x: jax.device_put(x, sharding), coerce_batch_dtypes(batch))
 
 
 def pad_batch_to_multiple(batch: dict, multiple: int) -> dict:
@@ -217,7 +244,7 @@ def shard_stacked_batch(batch: Any, mesh: Mesh) -> Any:
     from .mesh import data_sharding
     sharding = NamedSharding(mesh, P(None, *data_sharding(mesh).spec))
     return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), batch)
+        lambda x: jax.device_put(x, sharding), coerce_batch_dtypes(batch))
 
 
 def make_global_stacked_batch(local_batch: Any, mesh: Mesh) -> Any:
@@ -235,7 +262,290 @@ def make_global_stacked_batch(local_batch: Any, mesh: Mesh) -> Any:
         global_shape = (x.shape[0], x.shape[1] * n_shards) + x.shape[2:]
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
-    return jax.tree_util.tree_map(_make, local_batch)
+    return jax.tree_util.tree_map(_make, coerce_batch_dtypes(local_batch))
+
+
+def _issue_device_put(arrays, devices):
+    """The ONE host→device transfer issue point of the coalesced staging
+    path: a single batched ``jax.device_put`` call moves every per-device
+    staging region of a batch. Module-level so tests can wrap it with a
+    counting shim and assert exactly one transfer per training batch."""
+    return jax.device_put(arrays, devices)
+
+
+def _device_batch_shards(mesh: Mesh):
+    """[(device, batch_shard_id)] for this process's addressable devices,
+    ordered by mesh position. shard_id = data_coord * fsdp_size + fsdp_coord
+    — the same linearization data_sharding uses for the leading batch dim."""
+    ax = {name: i for i, name in enumerate(mesh.axis_names)}
+    fsdp_size = mesh.shape.get("fsdp", 1)
+    out = []
+    pi = jax.process_index()
+    for idx in np.ndindex(mesh.devices.shape):
+        dev = mesh.devices[idx]
+        if dev.process_index != pi:
+            continue
+        d = idx[ax["data"]] if "data" in ax else 0
+        f = idx[ax["fsdp"]] if "fsdp" in ax else 0
+        out.append((dev, d * fsdp_size + f))
+    return out
+
+
+class _StagingLayout:
+    """Byte layout of one batch spec inside the coalesced staging buffer,
+    plus its reusable host ring and compiled device-side unpack."""
+
+    __slots__ = ("fields", "region_nbytes", "ring_buf", "inflight", "slot",
+                 "unpack", "pb", "batch_axis")
+
+    def __init__(self, mesh: Mesh, spec: Tuple, stacked: bool, ring: int,
+                 shards):
+        self.batch_axis = 1 if stacked else 0
+        n_shards = batch_shard_count_total(mesh)
+        n_local = len({s for _, s in shards})
+        b_local = spec[0][1][self.batch_axis]
+        if b_local % n_local:
+            raise ValueError(
+                f"local batch {b_local} not divisible by this process's "
+                f"{n_local} batch shards")
+        self.pb = b_local // n_local
+        fields = []
+        off = 0
+        for key, shape, dtype in spec:
+            if len(shape) <= self.batch_axis or \
+                    shape[self.batch_axis] != b_local:
+                raise ValueError(
+                    f"leaf {key!r} shape {shape} does not carry the batch "
+                    f"dim {b_local} on axis {self.batch_axis}")
+            rest = shape[self.batch_axis + 1:]
+            k_steps = shape[0] if stacked else 1
+            nbytes = self.pb * int(np.prod(rest, dtype=np.int64)) \
+                * k_steps * dtype.itemsize
+            fields.append((key, shape, dtype, off, int(nbytes)))
+            off += (int(nbytes) + 7) // 8 * 8  # 8-byte-align every leaf
+        self.fields = tuple(fields)
+        self.region_nbytes = off
+        self.ring_buf = np.empty((ring, len(shards), off), np.uint8)
+        self.inflight: list = [None] * ring
+        self.slot = 0
+        self.unpack = _build_unpack(mesh, self.fields, stacked, n_shards,
+                                    self.pb)
+
+    def pack(self, batch, shards, lo_shard: int):
+        """Copy each device's rows of every leaf into its staging region
+        (one host memcpy pass); returns (slot, per-device uint8 views)."""
+        slot = self.slot
+        self.slot = (slot + 1) % len(self.inflight)
+        prev = self.inflight[slot]
+        if prev is not None:
+            # the slot's previous transfer may still be reading the host
+            # buffer (async H2D): wait before overwriting
+            jax.block_until_ready(prev)
+            self.inflight[slot] = None
+        buf = self.ring_buf[slot]
+        stacked = self.batch_axis == 1
+        for di, (_dev, shard) in enumerate(shards):
+            r0 = (shard - lo_shard) * self.pb
+            r1 = r0 + self.pb
+            for key, shape, dtype, off, nbytes in self.fields:
+                src = batch[key][:, r0:r1] if stacked else batch[key][r0:r1]
+                dst = buf[di, off:off + nbytes].view(dtype)
+                np.copyto(dst.reshape(src.shape), src)
+        # (1, region) row views: the per-device shard shape of the global
+        # (n_shards, region) flat array
+        return slot, [buf[di:di + 1] for di in range(len(shards))]
+
+
+def batch_shard_count_total(mesh: Mesh) -> int:
+    return mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+
+
+# unpack programs shared across equal meshes (weak keys: a cache entry dies
+# with its mesh instead of pinning device arrays — see mesh.py note)
+_UNPACK_CACHE: "weakref.WeakKeyDictionary[Mesh, Dict]" = \
+    weakref.WeakKeyDictionary()
+_UNPACK_LOCK = threading.Lock()
+
+
+def _build_unpack(mesh: Mesh, fields: Tuple, stacked: bool, n_shards: int,
+                  pb: int):
+    """Compile flat (n_shards, region_bytes) uint8 → the batch pytree.
+
+    Each leaf is sliced out of its shard's region, bitcast to its dtype and
+    reshaped back; the shard axis merges into the batch dim. All slicing is
+    shard-local, so XLA lowers this to per-device copies — no collectives.
+    """
+    from .mesh import data_sharding
+    key = (fields, stacked)
+    with _UNPACK_LOCK:
+        per_mesh = _UNPACK_CACHE.get(mesh)
+        if per_mesh is None:
+            per_mesh = {}
+            _UNPACK_CACHE[mesh] = per_mesh
+        hit = per_mesh.get(key)
+    if hit is not None:
+        return hit
+    flat_sh = NamedSharding(mesh, P(("data", "fsdp")))
+    leaf_sh = data_sharding(mesh) if not stacked else \
+        NamedSharding(mesh, P(None, *data_sharding(mesh).spec))
+
+    def unpack(flat):
+        import jax.numpy as jnp
+        out = {}
+        for name, shape, dtype, off, nbytes in fields:
+            jdt = dtype if dtype != np.bool_ else np.dtype(np.uint8)
+            seg = jax.lax.slice(flat, (0, off), (n_shards, off + nbytes))
+            if stacked:
+                k_steps, rest = shape[0], shape[2:]
+                tgt = (n_shards, k_steps, pb) + rest
+            else:
+                rest = shape[1:]
+                tgt = (n_shards, pb) + rest
+            isize = np.dtype(dtype).itemsize
+            if isize > 1:
+                seg = seg.reshape(tgt + (isize,))
+            else:
+                seg = seg.reshape(tgt)
+            val = jax.lax.bitcast_convert_type(seg, jdt)
+            if dtype == np.bool_:
+                val = val.astype(jnp.bool_)
+            if stacked:
+                val = val.transpose((1, 0, 2) + tuple(
+                    range(3, 3 + len(rest))))
+                val = val.reshape((shape[0], n_shards * pb) + rest)
+            else:
+                val = val.reshape((n_shards * pb,) + rest)
+            out[name] = val
+        return out
+
+    out_sh = {name: leaf_sh for name, *_ in fields}
+    jitted = jax.jit(unpack, in_shardings=flat_sh, out_shardings=out_sh)
+    with _UNPACK_LOCK:
+        per_mesh[key] = jitted
+    return jitted
+
+
+class StagedBatch:
+    """A batch whose bytes are on device (single coalesced transfer issued)
+    but whose leaf arrays are not yet sliced out.
+
+    The split exists for thread safety: the staging thread only MOVES DATA
+    (``device_put`` has no cross-device rendezvous, so it is safe to issue
+    concurrently with the main thread's jitted steps), while ``finalize()``
+    — the tiny compiled unpack program, a multi-device XLA execution —
+    must run on the CONSUMER thread. Launching multi-device executions
+    from two threads interleaves their per-device enqueue order and can
+    deadlock against a collective-bearing train/eval step (observed on the
+    CPU backend); dispatching unpack and step from one thread keeps the
+    order consistent by construction. Dispatch is async, so none of the
+    overlap is lost.
+    """
+
+    __slots__ = ("flat", "_unpack")
+
+    def __init__(self, flat, unpack):
+        self.flat = flat
+        self._unpack = unpack
+
+    def block_until_ready(self):
+        """Wait for the host→device transfer (used by the staging thread's
+        transfer-time accounting; jax.block_until_ready duck-calls this)."""
+        self.flat.block_until_ready()
+        return self
+
+    def finalize(self):
+        """Slice/bitcast the device-resident bytes into the batch pytree.
+        Consumer-thread only (see class docstring)."""
+        return self._unpack(self.flat)
+
+
+def finalize_staged(batch):
+    """Resolve a StagedBatch to its pytree; pass anything else through."""
+    return batch.finalize() if isinstance(batch, StagedBatch) else batch
+
+
+class CoalescedStager:
+    """Coalesced host→device staging: ONE transfer issue per batch.
+
+    Instead of a ``device_put`` per leaf (and per shard under the hood),
+    each batch is packed into one contiguous, reused (ring-buffered) host
+    staging region per addressable device, moved with a single batched
+    ``device_put`` call, and assembled into a global flat array via
+    ``make_array_from_single_device_arrays`` (no host-side gather — every
+    device receives exactly its shard's bytes). ``put`` returns a
+    ``StagedBatch``; the consumer finalizes it into leaf arrays via a tiny
+    compiled on-device program (see StagedBatch for why that split is
+    load-bearing). Fewer, larger transfers is what moves
+    ``device_put_MBps``; the ring means zero per-batch host allocation on
+    the hot path.
+
+    ``stacked=True`` stages (K, B, ...) fused-loop batches (batch dim =
+    axis 1). Works single- and multi-process (each process contributes its
+    addressable devices' regions). Thread-safe: one lock serializes pack +
+    issue, so the train and eval staging threads may share a stager.
+
+    Stage counters: pack time → "stage", transfer issue → "transfer"
+    (``records_stages`` tells device_prefetch to only add its completion
+    wait, not re-count items).
+    """
+
+    records_stages = True
+
+    def __init__(self, mesh: Mesh, stacked: bool = False, ring: int = 3):
+        self.mesh = mesh
+        self.stacked = stacked
+        self.ring = max(2, ring)
+        self._lock = threading.Lock()
+        self._layouts: Dict[Tuple, _StagingLayout] = {}
+        self._shards = _device_batch_shards(mesh)
+        if not self._shards:
+            raise ValueError("no addressable devices on this process")
+        self._devices = [d for d, _ in self._shards]
+        self._n_shards = batch_shard_count_total(mesh)
+        self._lo_shard = min(s for _, s in self._shards)
+
+    def _spec_of(self, batch) -> Tuple:
+        return tuple(sorted(
+            (k, np.shape(v), np.dtype(np.asarray(v).dtype))
+            for k, v in batch.items()))
+
+    def __call__(self, batch):
+        return self.put(batch)
+
+    def put(self, batch):
+        from ..utils.metrics import input_stages
+        batch = coerce_batch_dtypes(
+            {k: np.asarray(v) for k, v in batch.items()})
+        items = 0
+        for key in ("labels", "idx"):
+            if key in batch:
+                items = int(batch[key].size)
+                break
+        with self._lock:
+            t0 = time.perf_counter()
+            spec = self._spec_of(batch)
+            layout = self._layouts.get(spec)
+            if layout is None:
+                layout = _StagingLayout(self.mesh, spec, self.stacked,
+                                        self.ring, self._shards)
+                self._layouts[spec] = layout
+            slot, views = layout.pack(batch, self._shards, self._lo_shard)
+            t1 = time.perf_counter()
+            nbytes = len(views) * layout.region_nbytes
+            input_stages.add("stage", t1 - t0, items=items, nbytes=nbytes)
+            pieces = _issue_device_put(views, self._devices)
+            layout.inflight[slot] = pieces
+            flat = jax.make_array_from_single_device_arrays(
+                (self._n_shards, layout.region_nbytes),
+                NamedSharding(self.mesh, P(("data", "fsdp"))), pieces)
+            input_stages.add("transfer", time.perf_counter() - t1,
+                             items=items, nbytes=nbytes)
+            return StagedBatch(flat, layout.unpack)
+
+    def put_now(self, batch):
+        """put + finalize in one call — for single-thread callers (tests,
+        step_flops); the pipelined path finalizes on the consumer thread."""
+        return self.put(batch).finalize()
 
 
 def make_global_batch(local_batch: Any, mesh: Mesh) -> Any:
@@ -250,4 +560,4 @@ def make_global_batch(local_batch: Any, mesh: Mesh) -> Any:
         global_shape = (x.shape[0] * n_shards,) + x.shape[1:]
         return jax.make_array_from_process_local_data(sharding, x, global_shape)
 
-    return jax.tree_util.tree_map(_make, local_batch)
+    return jax.tree_util.tree_map(_make, coerce_batch_dtypes(local_batch))

@@ -115,6 +115,30 @@ class SummaryHook(_CadenceHook):
         self.writer.write_scalars(step, scalars)
 
 
+class InputStagesHook(_CadenceHook):
+    """Export the input-pipeline stage counters (utils.metrics.input_stages:
+    decode / stack / stage / transfer / dispatch_wait) to metrics.jsonl as a
+    typed ``{"event": "input_stages", ...}`` record every N steps — the
+    attribution telemetry bench.py and docs/input_pipeline.md describe.
+    Counters are cumulative since process start (or the last reset), so
+    consumers can difference consecutive records for window rates."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        from ..utils.metrics import input_stages
+        snap = input_stages.snapshot()
+        if snap:
+            self.writer.write_event("input_stages",
+                                    {"step": int(step), "stages": snap})
+
+
 class CheckpointHook:
     """Save via CheckpointManager on its step/time policy.
 

@@ -31,7 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import (batch_shard_count, create_mesh, data_sharding,
                              present_batch_axes, shard_map_compat)
-from ..parallel.sharding import make_global_batch, shard_batch
+from ..parallel.sharding import (finalize_staged, make_global_batch,
+                                 shard_batch)
 from .optimizers import (create_optimizer, decoupled_decay,
                          loss_weight_decay)
 from .schedules import create_schedule
@@ -324,9 +325,31 @@ class Trainer:
         self._jitted_idx = None
         self._jitted_idx_multi = None
         self.state: Optional[TrainState] = None
-        # single-process: device_put the full batch sharded; multi-process:
-        # every process contributes its local shard of the global array
-        if jax.process_count() > 1:
+        ct = cfg.data.coalesced_transfer
+        if ct not in ("auto", "on", "off"):
+            raise ValueError(f"unknown coalesced_transfer setting {ct!r}")
+        if ct == "auto":
+            # like data.device_augment: auto = on iff a real accelerator is
+            # attached. Coalescing exists to amortize per-call transfer
+            # overhead on a device link; on the CPU backend (tests, tiny
+            # local runs) the extra pack/unpack per batch only costs
+            ct = "off" if jax.default_backend() == "cpu" else "on"
+        if ct == "on":
+            # coalesced staging (parallel/sharding.CoalescedStager): one
+            # contiguous ring-buffered host region per device, a single
+            # device_put issue per batch, per-shard placement via
+            # make_array_from_single_device_arrays — covers single- AND
+            # multi-process (each process contributes its local regions)
+            from ..parallel.sharding import CoalescedStager
+            ring = max(cfg.data.staging_ring, cfg.data.transfer_depth + 2)
+            self._put_batch = CoalescedStager(self.mesh, stacked=False,
+                                              ring=ring)
+            self._put_multi_batch = CoalescedStager(self.mesh, stacked=True,
+                                                    ring=ring)
+        elif jax.process_count() > 1:
+            # per-leaf fallback. single-process: device_put the full batch
+            # sharded; multi-process: every process contributes its local
+            # shard of the global array
             from ..parallel.sharding import make_global_stacked_batch
             self._put_batch = lambda b: make_global_batch(b, self.mesh)
             self._put_multi_batch = \
@@ -488,7 +511,8 @@ class Trainer:
                 self._jitted_idx_raw, self.state, self._put_idx(batch),
                 *self._dev_data)
         return profiling.flops_per_step(
-            self.jitted_train_step(), self.state, self._put_batch(batch))
+            self.jitted_train_step(), self.state,
+            finalize_staged(self._put_batch(batch)))
 
     def jitted_index_multi_step(self, k: int = 0):
         del k
@@ -595,20 +619,22 @@ class Trainer:
         use_idx = self._dev_data is not None
         put_one = self._put_idx if use_idx else self._put_batch
         put_multi = self._put_idx_multi if use_idx else self._put_multi_batch
+        depth = max(1, self.cfg.data.transfer_depth)
         if k == 1:
             from ..data.device_prefetch import device_prefetch
             step_fn = self.jitted_index_step() if use_idx \
                 else self.jitted_train_step()
-            # keep one transfer in flight behind compute; the wrapped iterator
-            # is cached per data_iter so segmented training (repeated train()
-            # calls over one shared iterator, e.g. train_and_eval) doesn't
-            # drop the prefetched batches between segments
+            # a dedicated transfer thread keeps `depth` device-resident
+            # batches queued behind compute; the wrapped iterator is cached
+            # per data_iter so segmented training (repeated train() calls
+            # over one shared iterator, e.g. train_and_eval) doesn't drop
+            # the prefetched batches between segments
             if self._dev_prefetch is None or self._dev_prefetch[0] is not data_iter:
                 if self._dev_prefetch is not None:
                     self._dev_prefetch[1].close()  # stop old worker threads
                 self._dev_prefetch = (
                     data_iter,
-                    device_prefetch(iter(data_iter), put_one, depth=2))
+                    device_prefetch(iter(data_iter), put_one, depth=depth))
             dev_iter = self._dev_prefetch[1]
             for step in range(start_step, num_steps):
                 try:
@@ -627,8 +653,8 @@ class Trainer:
         multi_fn = self.jitted_index_multi_step(k) if use_idx \
             else self.jitted_multi_step(k)
         step = start_step
-        # K-batch draw + stack runs on a background thread; device_prefetch
-        # keeps one stacked transfer in flight behind the scan dispatch, so
+        # K-batch draw + stack runs on its own thread; the dedicated
+        # transfer thread stages stacked groups behind the scan dispatch, so
         # the dispatch thread never waits on host-side input prep. Cached per
         # data_iter (like the K=1 path) so segmented training keeps its
         # queue; entry[2] carries a [stacked_group, offset] remainder left by
@@ -640,7 +666,7 @@ class Trainer:
             self._multi_prefetch = [
                 data_iter,
                 device_prefetch(threaded_stacker(iter(data_iter), k),
-                                put_multi, depth=2),
+                                put_multi, depth=depth),
                 None]
         entry = self._multi_prefetch
         stacked_iter = entry[1]
@@ -704,34 +730,53 @@ class Trainer:
         return self.state, metrics
 
     def evaluate(self, data_iter: Iterator, num_batches: int) -> Dict[str, float]:
+        """Pipelined evaluation: padding + host→device staging run on the
+        dedicated transfer thread (data/device_prefetch.device_prefetch)
+        while the consumer dispatches eval steps — the serial
+        pad → put → run chain was the measured 46.7 vs 499 img/s eval gap
+        (BENCH_r05). The prefetcher may draw up to transfer_depth + 2
+        batches beyond ``num_batches`` from ``data_iter``; eval streams are
+        one-pass per round (or infinite), so nothing meaningful is lost."""
+        from ..data.device_prefetch import device_prefetch
         from ..parallel.sharding import pad_batch_to_multiple
         step_fn = self.jitted_eval_step()
         n_shards = batch_shard_count(self.mesh)
+
+        def padded():
+            for batch in data_iter:
+                yield pad_batch_to_multiple(batch, n_shards)
+
+        dev_iter = device_prefetch(
+            padded(), self._put_batch,
+            depth=max(1, self.cfg.data.transfer_depth))
         # accumulate ON DEVICE (tiny async adds) and pull once at the end —
         # a per-batch int() would sync host<->device every eval step
         totals = None
-        for _ in range(num_batches):
-            try:
-                batch = next(data_iter)
-            except StopIteration:
-                # one-pass streams (ImageNet eval) can exhaust before
-                # num_batches; single-process, return metrics over the
-                # batches actually consumed. Multi-process we must NOT
-                # break unilaterally — the other processes would block in
-                # the next collective — so fail loudly instead.
-                if jax.process_count() > 1:
-                    raise RuntimeError(
-                        "eval stream exhausted mid-evaluation on this "
-                        "process; with multiple processes this would "
-                        "deadlock the collective step — size "
-                        "eval_batch_count to the smallest per-process "
-                        "shard") from None
-                break
-            batch = pad_batch_to_multiple(batch, n_shards)
-            batch = self._put_batch(batch)
-            out = step_fn(self.state, batch)
-            totals = out if totals is None else \
-                jax.tree_util.tree_map(jnp.add, totals, out)
+        try:
+            for _ in range(num_batches):
+                try:
+                    batch = next(dev_iter)
+                except StopIteration:
+                    # one-pass streams (ImageNet eval) can exhaust before
+                    # num_batches; single-process, return metrics over the
+                    # batches actually consumed. Multi-process we must NOT
+                    # break unilaterally — the other processes would block in
+                    # the next collective — so fail loudly instead.
+                    if jax.process_count() > 1:
+                        raise RuntimeError(
+                            "eval stream exhausted mid-evaluation on this "
+                            "process; with multiple processes this would "
+                            "deadlock the collective step — size "
+                            "eval_batch_count to the smallest per-process "
+                            "shard") from None
+                    break
+                out = step_fn(self.state, batch)
+                totals = out if totals is None else \
+                    jax.tree_util.tree_map(jnp.add, totals, out)
+        finally:
+            # stop the staging thread (the caller keeps ownership of
+            # data_iter itself — Evaluator reuses caller-supplied iterators)
+            dev_iter.close()
         if totals is None:
             return {"precision": 0.0, "loss": 0.0, "count": 0}
         count = int(totals["count"])

@@ -107,6 +107,18 @@ class DataConfig:
     # only indices (data/device_dataset.py) — auto = on iff TPU,
     # single-process, CIFAR-scale. Implies device_augment.
     device_dataset: str = "auto"      # auto | on | off
+    # -- overlapped staging (docs/input_pipeline.md) --------------------
+    # coalesce each batch into one contiguous staging buffer and issue a
+    # single device_put per batch (parallel/sharding.CoalescedStager);
+    # "off" falls back to per-leaf device_put. auto = on iff running on a
+    # real accelerator (per-call transfer overhead is what it amortizes)
+    coalesced_transfer: str = "auto"  # auto | on | off
+    # device-resident batches the dedicated transfer thread keeps queued
+    # ahead of dispatch (data/device_prefetch.device_prefetch)
+    transfer_depth: int = 2
+    # reused host staging buffers; must cover the transfers in flight
+    # (transfer_depth + the one behind the current put)
+    staging_ring: int = 4
     # eval pipeline
     eval_batch_size: int = 100        # reference resnet_cifar_eval.py batch of 100
 

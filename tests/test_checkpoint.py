@@ -405,8 +405,12 @@ def test_crash_resume_step_exact_and_evaluator_continuity(tmp_path):
     assert p.returncode != 0  # it really died
 
     jsonl = os.path.join(str(tmp_path), "train", "metrics.jsonl")
+    # scalar rows only: typed {"event": ...} records (input_stages
+    # telemetry) share the step key and would double-count steps
     with open(jsonl) as f:
-        steps_before = [json.loads(l)["step"] for l in f if l.strip()]
+        steps_before = [r["step"]
+                        for r in (json.loads(l) for l in f if l.strip())
+                        if "event" not in r]
     # a SIGKILL mid-async-save may leave an orphan dir; resume must use the
     # latest COMPLETE checkpoint (crash-orphan-safe layout, round 4)
     n_rows_before = len(steps_before)
@@ -419,7 +423,9 @@ def test_crash_resume_step_exact_and_evaluator_continuity(tmp_path):
         timeout=600).returncode
     assert rc == 0
     with open(jsonl) as f:
-        all_steps = [json.loads(l)["step"] for l in f if l.strip()]
+        all_steps = [r["step"]
+                     for r in (json.loads(l) for l in f if l.strip())
+                     if "event" not in r]
     resumed = all_steps[n_rows_before:]
     assert resumed, "resumed run wrote no metrics"
     restart = resumed[0]

@@ -151,10 +151,95 @@ def test_device_prefetch():
 
     out = list(device_prefetch(iter([1, 2, 3, 4]), put, depth=2))
     assert out == [10, 20, 30, 40]
-    # transfers dispatched ahead: when 10 was yielded, 1..3 were already put
+    # transfers dispatched (on the staging thread) in order
     assert puts == [1, 2, 3, 4]
 
     # shorter than depth
     assert list(device_prefetch(iter([5]), put, depth=3)) == [50]
     # empty
     assert list(device_prefetch(iter([]), put, depth=2)) == []
+
+
+def test_device_prefetch_slow_put_does_not_block_consumer():
+    """The tentpole overlap contract: staging runs on a DEDICATED transfer
+    thread, so a put() stuck on batch N must not block the consumer from
+    draining already-staged batches."""
+    import threading
+    from distributed_resnet_tensorflow_tpu.data.device_prefetch import (
+        device_prefetch)
+    gate = threading.Event()
+
+    def put(x):
+        if x >= 3:
+            # batch 3's transfer hangs until the test releases it
+            assert gate.wait(10)
+        return x * 10
+
+    it = device_prefetch(iter([1, 2, 3, 4]), put, depth=2)
+    got = []
+    t = threading.Thread(target=lambda: got.extend([next(it), next(it)]))
+    t.start()
+    # batches 1 and 2 must arrive while put(3) is blocked on the gate
+    t.join(timeout=5)
+    assert not t.is_alive() and got == [10, 20]
+    gate.set()
+    assert list(it) == [30, 40]
+
+
+def test_device_prefetch_close_during_inflight_staging_joins_workers():
+    """close() while a put() is mid-flight must stop and join the staging
+    thread (and any upstream source thread) without leaking."""
+    import threading
+    import time as _time
+    from distributed_resnet_tensorflow_tpu.data.device_prefetch import (
+        device_prefetch, threaded_iterator)
+
+    def src():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    def slow_put(x):
+        _time.sleep(0.05)
+        return x
+
+    existing = set(threading.enumerate())
+    it = device_prefetch(
+        threaded_iterator(src(), depth=2, name="drt-test-src"),
+        slow_put, depth=2)
+    assert next(it) == 0
+    it.close()
+    deadline = _time.time() + 5
+    while _time.time() < deadline:
+        leaked = [t for t in threading.enumerate() if t not in existing
+                  and ("drt-device-stage" in t.name
+                       or "drt-test-src" in t.name) and t.is_alive()]
+        if not leaked:
+            break
+        _time.sleep(0.05)
+    assert not leaked, leaked
+
+
+def test_threaded_stacker_logs_dropped_tail(caplog):
+    """A trailing partial group of < k batches cannot be fused-dispatched
+    and is dropped — but never silently (no-silent-caps rule)."""
+    import logging
+    from distributed_resnet_tensorflow_tpu.data.device_prefetch import (
+        threaded_stacker)
+    batches = [{"x": np.full((2,), i)} for i in range(7)]
+    with caplog.at_level(
+            logging.WARNING,
+            logger="distributed_resnet_tensorflow_tpu.data.device_prefetch"):
+        out = list(threaded_stacker(iter(batches), 3, depth=2))
+    assert len(out) == 2  # 2 full groups; the 1-batch tail is dropped
+    assert any("dropping 1 trailing batch" in r.message
+               for r in caplog.records)
+    # exact multiple: no warning
+    caplog.clear()
+    with caplog.at_level(
+            logging.WARNING,
+            logger="distributed_resnet_tensorflow_tpu.data.device_prefetch"):
+        out = list(threaded_stacker(iter(batches[:6]), 3, depth=2))
+    assert len(out) == 2
+    assert not any("trailing batch" in r.message for r in caplog.records)

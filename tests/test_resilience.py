@@ -416,9 +416,13 @@ def test_sigterm_kill_and_resume_exact_continuation(tmp_path):
     jsonl = os.path.join(str(tmp_path), "train", "metrics.jsonl")
 
     def metric_steps():
+        # scalar rows only: typed {"event": ...} records (input_stages
+        # telemetry) share the step key and would double-count steps
         try:
             with open(jsonl) as f:
-                return [json.loads(l)["step"] for l in f if l.strip()]
+                return [r["step"]
+                        for r in (json.loads(l) for l in f if l.strip())
+                        if "event" not in r]
         except FileNotFoundError:
             return []
 
