@@ -67,5 +67,7 @@ def create_input_iterator(cfg, mode: str = "train", shard_index: int = 0,
                                  device_standardize=device_augment_enabled(
                                      cfg, mode),
                                  decode_processes=d.decode_processes,
-                                 deterministic=deterministic)
+                                 deterministic=deterministic,
+                                 max_corrupt_records=d.max_corrupt_records,
+                                 verify_crc=d.verify_crc)
     raise ValueError(f"unknown dataset {d.dataset!r}")

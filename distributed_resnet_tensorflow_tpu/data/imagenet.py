@@ -72,6 +72,8 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                       device_standardize: bool = False,
                       decode_processes: int = 0,
                       deterministic: bool = False,
+                      max_corrupt_records: int = 0,
+                      verify_crc: bool = False,
                       ) -> Iterator[Dict[str, np.ndarray]]:
     """``device_standardize``: batches stay uint8 (crop/flip done, VGG
     mean-subtract deferred to ops/augment.vgg_standardize inside the jitted
@@ -146,8 +148,15 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
             finally:
                 pf.close()
         else:
+            # max_corrupt_records > 0: tolerate truncated tails / torn
+            # shards with counted skips (data/tfrecord.py; {"event":
+            # "corrupt_record"} rows via CorruptRecordsHook). Flipped
+            # payload bytes are only caught when verify_crc is on (a
+            # python CRC32C pass per record — data.verify_crc). The
+            # native C++ prefetcher has its own CRC handling, stays strict.
             for path in ordered_files:
-                yield from read_tfrecords(path)
+                yield from read_tfrecords(path, verify_crc=verify_crc,
+                                          max_corrupt=max_corrupt_records)
 
     # stage 1: raw (jpeg_bytes, label) stream with file + buffer shuffle
     def raw_stream():

@@ -22,11 +22,16 @@ reference resnet_imagenet_main.py:117-136):
 """
 from __future__ import annotations
 
+import logging
 import os
 import struct
+import threading
+from collections import deque
 from typing import Dict, Iterator, List, Optional, Union
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
 # CRC32C (Castagnoli) with the TFRecord masking, table-driven
@@ -68,23 +73,127 @@ def masked_crc32c(data: bytes) -> int:
 # TFRecord container
 # ---------------------------------------------------------------------------
 
-def read_tfrecords(path: str, verify_crc: bool = False) -> Iterator[bytes]:
-    """Yield raw record payloads from one TFRecord file."""
+class CorruptRecordStats:
+    """Thread-safe per-process tally of skipped corrupt/truncated records.
+
+    Shared by every reader thread in the decode pipeline (like
+    ``utils.metrics.input_stages``); ``train.hooks.CorruptRecordsHook``
+    exports it to metrics.jsonl as ``{"event": "corrupt_record"}`` rows so
+    bit rot on the dataset shards is visible in the run telemetry, not just
+    buried in a worker log."""
+
+    RECENT = 8
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.repeats = 0
+        self._by_reason: Dict[str, int] = {}
+        self._recent: deque = deque(maxlen=self.RECENT)
+        self._sites: set = set()
+
+    def record(self, path: str, reason: str,
+               offset: Optional[int] = None) -> int:
+        """Count one corruption; returns the per-process total of DISTINCT
+        corrupt sites. ``offset`` (byte position of the record in ``path``)
+        dedupes re-reads: the input pipeline re-opens every shard each
+        epoch, and one unchanging bad record must cost the budget once, not
+        once per pass — only NEW sites count toward ``max_corrupt``.
+        ``offset=None`` always counts (no site identity available)."""
+        with self._lock:
+            if offset is not None:
+                site = (path, offset)
+                if site in self._sites:
+                    self.repeats += 1
+                    return self.count
+                self._sites.add(site)
+            self.count += 1
+            self._by_reason[reason] = self._by_reason.get(reason, 0) + 1
+            self._recent.append({"file": os.path.basename(path),
+                                 "reason": reason})
+            return self.count
+
+    def reset(self) -> None:
+        with self._lock:
+            self.count = 0
+            self.repeats = 0
+            self._by_reason.clear()
+            self._recent.clear()
+            self._sites.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"count": self.count, "repeats": self.repeats,
+                    "by_reason": dict(self._by_reason),
+                    "recent": list(self._recent)}
+
+
+#: process-global tally — every read_tfrecords caller (decode feeder
+#: threads, tools) reports here; hooks export it
+corrupt_records = CorruptRecordStats()
+
+
+def read_tfrecords(path: str, verify_crc: bool = False,
+                   max_corrupt: int = 0,
+                   stats: CorruptRecordStats = corrupt_records
+                   ) -> Iterator[bytes]:
+    """Yield raw record payloads from one TFRecord file.
+
+    ``max_corrupt`` > 0 tolerates damage instead of dying on the first bad
+    byte of a multi-day run: a record with a bad data CRC is skipped
+    (framing is still trustworthy — the length parsed fine), while a bad
+    LENGTH CRC or a truncated tail abandons the rest of the file (framing
+    lost; resyncing a TFRecord stream is guesswork). Every skip is counted
+    in ``stats`` (per-PROCESS total of DISTINCT (file, offset) sites —
+    re-reading the same bad record on a later epoch logs but does not eat
+    the budget) with a warning; when the total exceeds ``max_corrupt`` the
+    reader raises — mass corruption is a storage incident, not noise to
+    ride through. ``max_corrupt=0`` is the strict legacy behavior. Note
+    CRC mismatches are only detectable with ``verify_crc=True``;
+    truncation is always detected."""
+
+    def corrupt(reason: str, offset: int) -> bool:
+        """True = tolerate (skip/stop file), False = caller must raise."""
+        if max_corrupt <= 0:
+            return False
+        total = stats.record(path, reason, offset=offset)
+        log.warning("corrupt TFRecord tolerated (%d/%d this process): "
+                    "%s@%d: %s", total, max_corrupt, path, offset, reason)
+        if total > max_corrupt:
+            raise IOError(
+                f"{path}: {reason} — {total} corrupt records exceed "
+                f"data.max_corrupt_records={max_corrupt}; the dataset "
+                "looks damaged beyond bit rot")
+        return True
+
     with open(path, "rb") as f:
         while True:
+            rec_off = f.tell()
             header = f.read(12)
             if len(header) < 12:
+                # a partial trailing header was silent EOF in the legacy
+                # reader; strict mode (max_corrupt=0) must keep accepting
+                # files it always accepted, tolerant mode counts the tear
+                if header and max_corrupt > 0:
+                    corrupt("truncated header", rec_off)
                 return
             (length,) = struct.unpack("<Q", header[:8])
             (len_crc,) = struct.unpack("<I", header[8:12])
             if verify_crc and masked_crc32c(header[:8]) != len_crc:
-                raise IOError(f"{path}: corrupt length crc")
+                if not corrupt("corrupt length crc", rec_off):
+                    raise IOError(f"{path}: corrupt length crc")
+                return  # framing untrustworthy: abandon the file
             data = f.read(length)
-            if len(data) < length:
-                raise IOError(f"{path}: truncated record")
-            (data_crc,) = struct.unpack("<I", f.read(4))
+            tail = f.read(4)
+            if len(data) < length or len(tail) < 4:
+                if not corrupt("truncated record", rec_off):
+                    raise IOError(f"{path}: truncated record")
+                return
+            (data_crc,) = struct.unpack("<I", tail)
             if verify_crc and masked_crc32c(data) != data_crc:
-                raise IOError(f"{path}: corrupt data crc")
+                if not corrupt("corrupt data crc", rec_off):
+                    raise IOError(f"{path}: corrupt data crc")
+                continue  # framing intact: skip just this record
             yield data
 
 

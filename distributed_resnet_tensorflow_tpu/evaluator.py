@@ -60,6 +60,10 @@ class Evaluator:
         self.writer = writer
         self.best_precision = 0.0   # reference best_precision tracking
         self.last_step: Optional[int] = None
+        # instance-level so the bound spans run() calls: the poller only
+        # ever surfaces the NEWEST checkpoint, so "consecutive" failures
+        # accrue one per poll over the evaluator's lifetime
+        self.consecutive_failures = 0
         # a caller-supplied iterator is reused (must be infinite, e.g. the
         # CIFAR/synthetic generators); config-built iterators are rebuilt per
         # checkpoint because the ImageNet eval stream is one-pass
@@ -74,8 +78,15 @@ class Evaluator:
         """Restore a specific checkpoint + run eval_batch_count batches
         (reference ran 50 × bs=100, resnet_cifar_eval.py:111-122)."""
         self.trainer.state, _ = self.manager.restore(self.trainer.state, step)
-        result = self.trainer.evaluate(self._iter(),
-                                       self.cfg.eval.eval_batch_count)
+        try:
+            result = self.trainer.evaluate(self._iter(),
+                                           self.cfg.eval.eval_batch_count)
+        finally:
+            # back to the unmonitored phase: between rounds the evaluator
+            # legitimately makes no progress (checkpoint droughts), and a
+            # parked "eval" phase would read as a hang to the watchdog
+            if self.trainer.heartbeat is not None:
+                self.trainer.heartbeat.set_phase("poll")
         self.best_precision = max(self.best_precision, result["precision"])
         result["best_precision"] = self.best_precision
         self.last_step = step
@@ -96,9 +107,17 @@ class Evaluator:
             timeout_secs: float = 0.0) -> Dict[str, float]:
         """Poll-evaluate loop. ``eval_once`` (reference --eval_once flag) or
         ``max_evals`` bound it; otherwise runs until no new checkpoint appears
-        within ``timeout_secs`` (0 = single pass over what exists)."""
+        within ``timeout_secs`` (0 = single pass over what exists).
+
+        Damaged/vanished checkpoints are skipped, but only
+        ``eval.max_consecutive_failures`` times IN A ROW (0 = unbounded):
+        one torn step is the resilience layer doing its job; every step
+        failing means the trainer side is persistently broken, and an
+        evaluator spinning forever on it would hide that from the operator
+        — exit nonzero instead."""
         result: Dict[str, float] = {}
         n = 0
+        max_fail = self.cfg.eval.max_consecutive_failures
         while True:
             step = wait_for_new_checkpoint(
                 self.manager.directory, self.last_step,
@@ -115,9 +134,20 @@ class Evaluator:
                 # a long-running evaluator skips it and keeps polling
                 # rather than dying on exactly the damage the resilience
                 # layer exists to survive (docs/resilience.md)
-                log.warning("skipping checkpoint step %d: %s", step, e)
+                self.consecutive_failures += 1
+                log.warning("skipping checkpoint step %d (%d/%s consecutive "
+                            "failures): %s", step, self.consecutive_failures,
+                            max_fail or "unbounded", e)
                 self.last_step = step
+                if max_fail and self.consecutive_failures >= max_fail:
+                    raise RuntimeError(
+                        f"{self.consecutive_failures} consecutive "
+                        f"checkpoints failed to evaluate (last: step {step}:"
+                        f" {e}); the checkpoint stream looks persistently "
+                        "broken — raise eval.max_consecutive_failures to "
+                        "keep polling anyway") from e
                 continue
+            self.consecutive_failures = 0
             n += 1
             if self.cfg.eval.eval_once or (max_evals and n >= max_evals):
                 return result

@@ -1,10 +1,31 @@
-"""Multi-process launcher — successor of the reference's launcher tree.
+"""Multi-process launcher/supervisor — successor of the reference's launcher
+tree.
 
 The reference bootstrapped clusters with ~440 lines of bash deriving ps/worker
 host:port lists from SLURM and synthesizing per-node scripts
 (reference scripts/run_dist_tf_daint.sh:30-206, SURVEY.md §2.18). In the SPMD
 world a launcher only needs to start N identical processes with
 (coordinator, process_id) — everything else is the same program.
+
+Since the watchdog PR this is a real SUPERVISOR, not a serial waiter: it
+polls all children, and when any child exits BADLY (nonzero other than the
+resumable 75, or by signal) while siblings are still running it gives the
+survivors ``child_grace_secs`` to finish on their own (the in-process
+watchdog, resilience/watchdog.py, normally gets them out with exit 75 well
+within that), then escalates SIGTERM → SIGKILL so one dead worker can
+never wedge the whole allocation until the wall clock. A CLEAN or
+RESUMABLE first exit (0 or 75) arms only a much longer backstop grace —
+siblings legitimately finish or drain their preemption checkpoint at
+different speeds, and killing them would tear the very save the grace
+exists to protect.
+
+Exit-code aggregation (docs/resilience.md):
+  * any child's real failure (positive code other than 75) wins — a broken
+    job must never be masked as "preempted" and requeued forever;
+  * otherwise 75 if any child exited resumable OR died by signal (host
+    loss / OOM-kill — the requeue-and-resume shape) OR had to be torn down
+    by the supervisor;
+  * 0 only when every child finished cleanly.
 
 Modes:
   * ``--num_processes N`` local fan-out — the successor of the reference's
@@ -29,21 +50,31 @@ import os
 import signal
 import subprocess
 import sys
-from typing import List
+import time
+from typing import List, Optional
 
 from distributed_resnet_tensorflow_tpu.resilience.preemption import (
     RESUMABLE_EXIT_CODE)
 
 log = logging.getLogger(__name__)
 
+#: once any child has exited BADLY (non-resumable nonzero / signal), how
+#: long the siblings get before SIGTERM
+DEFAULT_CHILD_GRACE_SECS = 30.0
+#: after SIGTERM, how long before SIGKILL
+TERM_TO_KILL_SECS = 10.0
+#: grace multiplier/floor when the first exit was CLEAN (code 0): a slower
+#: sibling draining a long final checkpoint is the normal end of a healthy
+#: run, not a failure — tearing it down would turn success into a requeue.
+#: A sibling that instead wedges after a clean exit is covered by its own
+#: in-process watchdog (hang detection → exit 75), so this long stop is a
+#: backstop, not the primary detector.
+CLEAN_EXIT_GRACE_FLOOR_SECS = 300.0
+CLEAN_EXIT_GRACE_SCALE = 10.0
 
-def launch_local(num_processes: int, main_args: List[str],
-                 devices_per_process: int = 0, port: int = 8476) -> int:
-    """Spawn N copies of main.py on localhost over the loopback coordinator.
-    Returns the first nonzero exit code (0 if all succeed).
 
-    ``devices_per_process=0`` (default) honors a device count the user
-    already exported via XLA_FLAGS, falling back to 1."""
+def _spawn(num_processes: int, main_args: List[str],
+           devices_per_process: int, port: int) -> List[subprocess.Popen]:
     from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
         existing_device_count, virtual_cpu_env)
 
@@ -67,39 +98,146 @@ def launch_local(num_processes: int, main_args: List[str],
             os.makedirs("/tmp/drt_launch", exist_ok=True)
             out = open(f"/tmp/drt_launch/proc{pid}.log", "w")
         procs.append(subprocess.Popen(cmd, env=env, stdout=out, stderr=out))
+    return procs
+
+
+def _signal_all(procs: List[subprocess.Popen], sig: int,
+                skip_done: bool = True) -> None:
+    for p in procs:
+        if skip_done and p.poll() is not None:
+            continue
+        try:
+            p.send_signal(sig)
+        except ProcessLookupError:
+            pass
+
+
+def _aggregate_rc(codes: List[int], forced: set) -> int:
+    """Exit-code policy (module docstring): real failure > resumable > 0.
+    Signal deaths (negative codes) of children the supervisor did NOT kill
+    are host-loss-shaped → resumable. Children the supervisor tore down
+    usually carry no information beyond "the run needed teardown" (signal
+    death or the graceful 75) — EXCEPT a positive, non-resumable code: a
+    forced child that still exited with its own failure code crashed for
+    real (racing the teardown), and masking that as 75 would requeue a
+    deterministically-broken job until MAX_REQUEUES."""
+    rc = 0
+    tore_down = False
+    for i, code in enumerate(codes):
+        if i in forced:
+            tore_down = tore_down or code != 0
+            if code <= 0 or code == RESUMABLE_EXIT_CODE:
+                continue
+            # fall through: the child's own real failure still wins
+        if code == 0:
+            continue
+        if code < 0 or code == RESUMABLE_EXIT_CODE:
+            if rc == 0:
+                rc = RESUMABLE_EXIT_CODE
+        else:
+            rc = code  # real failure: wins over resumable, first one kept
+            break
+    if rc == 0 and tore_down:
+        # everyone we left alone succeeded but some children had to be
+        # killed — the run did not complete; requeue-shaped
+        rc = RESUMABLE_EXIT_CODE
+    return rc
+
+
+def launch_local(num_processes: int, main_args: List[str],
+                 devices_per_process: int = 0, port: int = 8476,
+                 child_grace_secs: float = DEFAULT_CHILD_GRACE_SECS,
+                 poll_secs: float = 0.2,
+                 procs_out: Optional[list] = None) -> int:
+    """Spawn N copies of main.py on localhost over the loopback coordinator
+    and supervise them to completion (see module docstring for the exit-code
+    aggregation). ``devices_per_process=0`` (default) honors a device count
+    the user already exported via XLA_FLAGS, falling back to 1.
+
+    ``procs_out``: optional list the spawned Popen objects are appended to —
+    the fault-injection tests need the children's pids to kill one
+    (tests/test_resilience.py kill-and-detect)."""
+    procs = _spawn(num_processes, main_args, devices_per_process, port)
+    if procs_out is not None:
+        procs_out.extend(procs)
 
     # forward SIGTERM (SLURM grace-period kill, kill.sh) to every child so
     # each commits its preemption checkpoint and exits resumable; the
-    # launcher then reports the children's own exit code
+    # supervisor then reports the children's own exit code
     def forward_term(signum, frame):
-        for p in procs:
-            try:
-                p.send_signal(signal.SIGTERM)
-            except ProcessLookupError:
-                pass
+        _signal_all(procs, signal.SIGTERM)
 
     try:
         prev_term = signal.signal(signal.SIGTERM, forward_term)
     except ValueError:  # not the main thread (embedded use) — no forwarding
         prev_term = None
-    rc = 0
+
+    clean_grace_secs = max(CLEAN_EXIT_GRACE_SCALE * child_grace_secs,
+                           CLEAN_EXIT_GRACE_FLOOR_SECS)
+    forced: set = set()
+    first_exit_at: Optional[float] = None
+    first_bad_exit_at: Optional[float] = None
+    termed_at: Optional[float] = None
     try:
-        for p in procs:
-            code = p.wait()
-            # precedence: real failure > resumable (75) > clean, regardless
-            # of child reap order — a genuinely failing job must never be
-            # masked as merely preempted (the SLURM shim would requeue it)
-            if code != 0 and rc in (0, RESUMABLE_EXIT_CODE):
-                rc = code
+        while True:
+            codes = [p.poll() for p in procs]
+            live = [i for i, c in enumerate(codes) if c is None]
+            if not live:
+                break
+            now = time.monotonic()
+            if first_exit_at is None and any(c is not None for c in codes):
+                first_exit_at = now
+            # a deliberate resumable exit (75) is not a failure: during a
+            # fleet-wide preemption children exit 75 at different speeds,
+            # and the short countdown would SIGKILL a slow sibling mid-
+            # preemption-checkpoint — the very save the grace protects
+            if first_bad_exit_at is None and \
+                    any(c is not None and c != 0 and
+                        c != RESUMABLE_EXIT_CODE for c in codes):
+                first_bad_exit_at = now
+                exited = {i: c for i, c in enumerate(codes) if c is not None}
+                log.warning(
+                    "child exit(s) %s with %d sibling(s) still running; "
+                    "giving them %.0fs before teardown", exited,
+                    len(live), child_grace_secs)
+            # the short countdown arms only on a BAD exit (nonzero
+            # non-resumable, or signal death); after clean/resumable-only
+            # exits the survivors get clean_grace_secs (finishing at
+            # different speeds is a healthy run's normal shape)
+            if first_bad_exit_at is not None:
+                teardown_due = now - first_bad_exit_at >= child_grace_secs
+            else:
+                teardown_due = first_exit_at is not None and \
+                    now - first_exit_at >= clean_grace_secs
+            if teardown_due and termed_at is None:
+                log.warning("teardown: SIGTERM to %d straggling child(ren) "
+                            "%.0fs after the first exit", len(live),
+                            now - first_exit_at)
+                forced.update(live)
+                _signal_all(procs, signal.SIGTERM)
+                termed_at = now
+            if termed_at is not None and now - termed_at >= TERM_TO_KILL_SECS:
+                log.error("teardown: SIGKILL to %d child(ren) that ignored "
+                          "SIGTERM", len(live))
+                forced.update(live)
+                _signal_all(procs, signal.SIGKILL)
+                termed_at = now  # keep kicking every TERM_TO_KILL_SECS
+            time.sleep(poll_secs)
+        rc = _aggregate_rc([p.returncode for p in procs], forced)
     except KeyboardInterrupt:  # kill.sh parity (reference scripts/kill.sh)
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
+        _signal_all(procs, signal.SIGTERM, skip_done=False)
         rc = 130
     finally:
         if prev_term is not None:
             signal.signal(signal.SIGTERM, prev_term)
+        for p in procs:  # reap everything; no zombies left to SLURM
+            try:
+                p.wait(timeout=TERM_TO_KILL_SECS)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                p.kill()
+                p.wait()
     if rc == RESUMABLE_EXIT_CODE:
-        log.warning("children preempted; exit code %d marks the run "
+        log.warning("children stopped resumable; exit code %d marks the run "
                     "resumable — relaunch with the same config to resume",
                     RESUMABLE_EXIT_CODE)
     return rc
@@ -107,11 +245,17 @@ def launch_local(num_processes: int, main_args: List[str],
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="local multi-process SPMD launcher")
+        description="local multi-process SPMD launcher/supervisor")
     ap.add_argument("--num_processes", type=int, default=2)
     ap.add_argument("--devices_per_process", type=int, default=0,
                     help="0 = inherit XLA_FLAGS device count, else 1")
     ap.add_argument("--port", type=int, default=8476)
+    ap.add_argument("--child_grace_secs", type=float,
+                    default=DEFAULT_CHILD_GRACE_SECS,
+                    help="seconds siblings get to exit on their own after "
+                         "the first BAD (non-resumable nonzero / signal) "
+                         "child exit, before SIGTERM/SIGKILL; clean/75 "
+                         "exits arm a 10x/300s-floor backstop instead")
     ap.add_argument("main_args", nargs=argparse.REMAINDER,
                     help="args after -- go to main.py")
     ns = ap.parse_args(argv)
@@ -119,7 +263,8 @@ def main(argv=None):
     if main_args and main_args[0] == "--":
         main_args = main_args[1:]
     sys.exit(launch_local(ns.num_processes, main_args,
-                          ns.devices_per_process, ns.port))
+                          ns.devices_per_process, ns.port,
+                          child_grace_secs=ns.child_grace_secs))
 
 
 if __name__ == "__main__":

@@ -17,6 +17,7 @@ process trees (reference resnet_cifar_main.py:339-399).
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import sys
@@ -29,12 +30,15 @@ from .data import create_input_iterator
 from .evaluator import Evaluator, make_eval_iterator
 from .parallel import initialize_from_config, is_chief
 from .resilience import Preempted, PreemptionListener, RESUMABLE_EXIT_CODE
+from .resilience.heartbeat import (PHASE_DONE, PHASE_FAILED,
+                                   PHASE_PREEMPTED)
 from .resilience.preemption import (collective_preempted,
                                     collective_should_stop)
 from .resilience.faultinject import maybe_wrap_from_env
 from .resilience.sentinel import train_with_nan_recovery
-from .train.hooks import (CheckpointHook, InputStagesHook, LoggingHook,
-                          NanGuardHook, SummaryHook)
+from .train.hooks import (CheckpointHook, CorruptRecordsHook, HeartbeatHook,
+                          InputStagesHook, LoggingHook, NanGuardHook,
+                          SummaryHook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
                            resolve_checkpoint_dir, stacked_layout_stamp)
@@ -83,6 +87,153 @@ def _make_train_source(cfg: ExperimentConfig, trainer: Trainer):
     # inert unless the chaos harness armed it via env
     # (resilience/faultinject.py; tests/test_resilience.py)
     return maybe_wrap_from_env(it)
+
+
+def _start_watchdog(cfg: ExperimentConfig, writer, listener,
+                    trainer: Optional[Trainer] = None,
+                    role: str = "train"):
+    """Build + start the heartbeat publisher and the health watchdog
+    (resilience/heartbeat.py, resilience/watchdog.py) when enabled —
+    ``resilience.watchdog.enabled=auto`` resolves to on iff the run has
+    peers. Returns (publisher, watchdog), both None when disabled.
+
+    The watchdog escalates through ``listener.request_stop`` (graceful,
+    coordinated stop at a step boundary) before its hard ``os._exit(75)``;
+    the publisher is attached to the trainer so eval batches tick liveness
+    too. ``role`` scopes the default beat directory: a standalone
+    evaluator job is its OWN jax world but shares ``log_root`` with the
+    trainers — publishing into their dir as "process 0" would mask
+    trainer-0's death from its peers and pollute their straggler
+    accounting."""
+    from .resilience.watchdog import Watchdog, watchdog_enabled
+    wd_cfg = cfg.resilience.watchdog
+    if not watchdog_enabled(wd_cfg, jax.process_count()):
+        return None, None
+    from .resilience.heartbeat import FileBeatTransport, HeartbeatPublisher
+    subdir = "heartbeats" if role == "train" else f"heartbeats-{role}"
+    if wd_cfg.heartbeat_dir:
+        # an explicit override is still role-scoped: trainers keep the
+        # exact dir, a non-train world gets a subdir under it — otherwise
+        # a standalone evaluator sharing the config would impersonate
+        # trainer process 0 in the trainers' beat directory
+        hb_dir = wd_cfg.heartbeat_dir if role == "train" \
+            else os.path.join(wd_cfg.heartbeat_dir, role)
+    else:
+        hb_dir = os.path.join(cfg.log_root, subdir)
+    transport = FileBeatTransport(hb_dir, jax.process_index())
+    publisher = HeartbeatPublisher(
+        transport, jax.process_index(),
+        interval_secs=wd_cfg.interval_secs).start()
+    if trainer is not None:
+        trainer.heartbeat = publisher
+    watchdog = Watchdog(
+        transport, publisher, jax.process_index(), jax.process_count(),
+        wd_cfg, writer=writer,
+        request_stop=listener.request_stop if listener is not None else None,
+    ).start()
+    log.info("health watchdog armed: %d processes, beats -> %s "
+             "(peer_timeout %.0fs, grace %.0fs)", jax.process_count(),
+             hb_dir, wd_cfg.peer_timeout_secs, wd_cfg.grace_secs)
+    return publisher, watchdog
+
+
+def _teardown_watchdog(publisher, watchdog, final_phase: str) -> None:
+    """Orderly watchdog shutdown: disarm FIRST (the run is leaving through
+    a legitimate path; the daemon must not hard-exit under it), then
+    publish the final phase so peers distinguish done/preempted (clean
+    departure) from failed (stop resumable, surface the real error)."""
+    if watchdog is not None:
+        watchdog.close()
+    if publisher is not None:
+        publisher.close(final_phase)
+
+
+@contextlib.contextmanager
+def _watchdog_session(cfg: ExperimentConfig, writer, listener,
+                      trainer: Optional[Trainer] = None,
+                      role: str = "train"):
+    """The teardown choreography every entry point needs, in ONE place:
+    success publishes a final ``done`` beat, Preempted publishes
+    ``preempted`` (clean coordinated departure — peers must not flag us as
+    lost), and any other error first asks the watchdog whether a PEER
+    caused it (exits with the verdict code; does not return) before
+    publishing ``failed``. Yields (publisher, watchdog), both None when
+    the watchdog is disabled."""
+    publisher, watchdog = _start_watchdog(cfg, writer, listener, trainer,
+                                          role=role)
+    try:
+        yield publisher, watchdog
+    except Preempted:
+        _teardown_watchdog(publisher, watchdog, PHASE_PREEMPTED)
+        raise
+    except BaseException as e:
+        if isinstance(e, Exception):
+            # a collective error caused by a dead peer exits 75 here
+            # (does not return); our OWN errors fall through and propagate
+            _exit_for_peer_failure(watchdog, e)
+        _teardown_watchdog(publisher, watchdog, PHASE_FAILED)
+        raise
+    else:
+        _teardown_watchdog(publisher, watchdog, PHASE_DONE)
+
+
+def _arm_watchdog_hooks(hooks: list, publisher) -> None:
+    """Wire the heartbeat publisher into the step-hook chain — shared by
+    run_train and run_train_and_eval so the two can't drift."""
+    if publisher is None:
+        return
+    # position 0: the beat must reflect step N even if a later hook
+    # raises mid-chain
+    hooks.insert(0, HeartbeatHook(publisher))
+    for h in hooks:
+        # cadence saves flip to the unmonitored "save" phase — a slow
+        # shared-FS save must not read as a hang
+        if isinstance(h, CheckpointHook):
+            h.heartbeat = publisher
+
+
+#: substrings that mark an exception as possibly caused by a dead/wedged
+#: peer (gloo transport, XLA collectives, the jax coordination service) —
+#: only these are worth the failure_verdict beat-poll; a plainly local
+#: error (NaN give-up, corrupt data, a hook TypeError) must propagate
+#: immediately, not stall every process ~peer_timeout_secs first.
+#: Deliberately BROAD ("connection", "timeout", "unavailable" can match a
+#: local NFS/object-store error too): a false positive costs one bounded
+#: ~peer_timeout beat-poll on an already-fatal crash, a false negative
+#: turns a requeue-able peer loss into a real-failure exit code
+_COLLECTIVE_ERROR_MARKERS = (
+    "collective", "gloo", "allreduce", "all-reduce", "all_gather",
+    "allgather", "connection", "socket", "barrier", "coordination",
+    "distributed", "deadline", "timed out", "timeout", "unavailable",
+    "peer", "preempt")
+
+
+def _collective_shaped(exc: BaseException) -> bool:
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in text for m in _COLLECTIVE_ERROR_MARKERS)
+
+
+def _exit_for_peer_failure(watchdog, exc: BaseException):
+    """After a runtime error in a multi-process step: if the beats say a
+    peer died or reported failure, exit with the watchdog's verdict code
+    (75 = peer loss, requeue; 1 = peer's real failure) instead of letting
+    the exception propagate into the atexit ``jax.distributed.shutdown``
+    barrier — which would block on the very peers that are gone.
+
+    Collective-shaped errors poll the beats up to the watchdog's default
+    wait (the error can surface milliseconds after the peer died, before
+    its beats age past the timeout); other errors get one immediate check
+    only — they are our own, and the stall would cost every process
+    ~peer_timeout_secs per crash."""
+    if watchdog is None:
+        return
+    verdict = watchdog.failure_verdict(
+        wait_secs=None if _collective_shaped(exc) else 0.0)
+    if verdict is not None:
+        kind, code, detail = verdict
+        log.error("step loop error attributed to a peer (%s): %r",
+                  kind, exc)
+        watchdog.exit_now(kind, code, detail)  # does not return
 
 
 def _peek(data_iter):
@@ -216,6 +367,8 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
         # input-pipeline stage attribution rides the summary cadence
         hooks.append(InputStagesHook(writer, cfg.train.summary_every_steps))
+        # corrupt-TFRecord tally (data.max_corrupt_records skips) likewise
+        hooks.append(CorruptRecordsHook(writer, cfg.train.summary_every_steps))
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
@@ -227,57 +380,73 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
 
     num_steps = max_steps if max_steps is not None else cfg.train.train_steps
     try:
-        stop_fn = None
-        if listener is not None:
-            # multi-process: the stop decision must flip at the SAME step
-            # boundary on every process or the SPMD step / save barrier
-            # deadlocks (resilience/preemption.py collective_should_stop)
-            stop_fn = collective_should_stop(listener) \
-                if jax.process_count() > 1 else listener.should_stop
-        if res.nan_max_strikes > 0:
-            def iter_factory(attempt: int):
-                if attempt == 0:
-                    return data_iter
-                # re-seed so the rollback does not replay the exact batch
-                # sequence that blew up (large odd stride keeps the offset
-                # seeds disjoint across attempts)
-                prev_seed = cfg.train.seed
-                cfg.train.seed = prev_seed + 1_000_003 * attempt
-                try:
-                    return _make_train_source(cfg, trainer)
-                finally:
-                    cfg.train.seed = prev_seed
+        # distributed health watchdog: every process beats; peer loss /
+        # hangs escalate to a coordinated stop, then exit 75
+        # (docs/resilience.md); the session publishes the final
+        # done/preempted/failed beat on every exit path
+        with _watchdog_session(cfg, writer, listener, trainer) \
+                as (publisher, watchdog):
+            _arm_watchdog_hooks(hooks, publisher)
+            stop_fn = None
+            if listener is not None:
+                # multi-process: the stop decision must flip at the SAME
+                # step boundary on every process or the SPMD step / save
+                # barrier deadlocks (preemption.py collective_should_stop)
+                stop_fn = collective_should_stop(listener) \
+                    if jax.process_count() > 1 else listener.should_stop
+            # NOTE: the phase stays "init" (unmonitored) until the FIRST
+            # step completes and HeartbeatHook flips it to "train" — the
+            # first step includes XLA compilation, which can legitimately
+            # exceed min_step_timeout_secs; arming hang detection before it
+            # would hard-exit 75 mid-compile and requeue-loop the job
+            if res.nan_max_strikes > 0:
+                def iter_factory(attempt: int):
+                    if attempt == 0:
+                        return data_iter
+                    # re-seed so the rollback does not replay the exact
+                    # batch sequence that blew up (large odd stride keeps
+                    # the offset seeds disjoint across attempts)
+                    prev_seed = cfg.train.seed
+                    cfg.train.seed = prev_seed + 1_000_003 * attempt
+                    try:
+                        return _make_train_source(cfg, trainer)
+                    finally:
+                        cfg.train.seed = prev_seed
 
-            state, metrics = train_with_nan_recovery(
-                trainer, manager, iter_factory, num_steps=num_steps,
-                hooks=tuple(hooks), start_step=start_step,
-                max_strikes=res.nan_max_strikes,
-                lr_backoff=res.nan_lr_backoff, stop_fn=stop_fn)
-        else:
-            state, metrics = trainer.train(data_iter, num_steps=num_steps,
-                                           hooks=tuple(hooks),
-                                           start_step=start_step,
-                                           stop_fn=stop_fn)
-        # agreed across processes: the save below is collective, so no
-        # process may enter it on a merely-local flag
-        preempted = collective_preempted(listener) \
-            if listener is not None else False
-        if preempted and int(state.step) < num_steps:
-            # a signal landing AFTER the last step finished is not a
-            # preemption — the run is done; exiting 75 would requeue a job
-            # with nothing left to do. Otherwise commit the preemption
-            # checkpoint UNCONDITIONALLY (even when cadence checkpointing
-            # is off): the whole point of a graceful stop is that a
-            # relaunch resumes instead of restarting
-            step = int(state.step)
-            manager.save(step, state, force=True)
-            manager.wait_until_finished()
-            log.warning("preempted (%s): checkpoint committed at step %d; "
-                        "exiting resumable", listener.reason(), step)
-            raise Preempted(step, listener.reason())
-        # final checkpoint + drain async saves
-        if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
-            manager.save(int(state.step), state, force=True)
+                state, metrics = train_with_nan_recovery(
+                    trainer, manager, iter_factory, num_steps=num_steps,
+                    hooks=tuple(hooks), start_step=start_step,
+                    max_strikes=res.nan_max_strikes,
+                    lr_backoff=res.nan_lr_backoff, stop_fn=stop_fn)
+            else:
+                state, metrics = trainer.train(
+                    data_iter, num_steps=num_steps, hooks=tuple(hooks),
+                    start_step=start_step, stop_fn=stop_fn)
+            # agreed across processes: the save below is collective, so no
+            # process may enter it on a merely-local flag
+            preempted = collective_preempted(listener) \
+                if listener is not None else False
+            if preempted and int(state.step) < num_steps:
+                # a signal landing AFTER the last step finished is not a
+                # preemption — the run is done; exiting 75 would requeue a
+                # job with nothing left to do. Otherwise commit the
+                # preemption checkpoint UNCONDITIONALLY (even when cadence
+                # checkpointing is off): the whole point of a graceful stop
+                # is that a relaunch resumes instead of restarting
+                step = int(state.step)
+                if publisher is not None:
+                    publisher.set_phase("save")
+                manager.save(step, state, force=True)
+                manager.wait_until_finished()
+                log.warning("preempted (%s): checkpoint committed at step "
+                            "%d; exiting resumable", listener.reason(), step)
+                raise Preempted(step, listener.reason())
+            # final checkpoint + drain async saves
+            if cfg.checkpoint.save_every_steps or \
+                    cfg.checkpoint.save_every_secs:
+                if publisher is not None:
+                    publisher.set_phase("save")
+                manager.save(int(state.step), state, force=True)
     finally:
         if listener is not None:
             listener.uninstall()
@@ -295,8 +464,16 @@ def run_eval(cfg: ExperimentConfig, max_evals: Optional[int] = None,
     if is_chief():
         writer = MetricsWriter(os.path.join(cfg.log_root, "eval"))
     try:
-        ev = Evaluator(cfg, writer=writer)
-        return ev.run(max_evals=max_evals, timeout_secs=timeout_secs)
+        with _watchdog_session(cfg, writer, None, role="eval") \
+                as (publisher, watchdog):
+            ev = Evaluator(cfg, writer=writer)
+            if publisher is not None:
+                # eval batches tick liveness; between rounds the evaluator
+                # parks in the unmonitored "poll" phase (checkpoint
+                # droughts are normal, not hangs)
+                ev.trainer.heartbeat = publisher
+                publisher.set_phase("poll")
+            return ev.run(max_evals=max_evals, timeout_secs=timeout_secs)
     finally:
         if writer is not None:
             writer.close()  # flush buffered events (see run_train)
@@ -336,6 +513,10 @@ def run_train_and_eval(cfg: ExperimentConfig):
             hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
             hooks.append(InputStagesHook(writer,
                                          cfg.train.summary_every_steps))
+            # corrupt-TFRecord tally exports here too — bit rot must be
+            # visible in telemetry in every training mode
+            hooks.append(CorruptRecordsHook(writer,
+                                            cfg.train.summary_every_steps))
 
     train_iter = _make_train_source(cfg, trainer)
 
@@ -355,32 +536,46 @@ def run_train_and_eval(cfg: ExperimentConfig):
     step = int(trainer.state.step)
     result = {}
     try:
-        while step < cfg.train.train_steps:
-            target = min(step + every, cfg.train.train_steps)
-            state, _ = trainer.train(train_iter, num_steps=target,
-                                     hooks=tuple(hooks), start_step=step,
-                                     stop_fn=stop_fn)
-            step = int(state.step)
-            preempted = collective_preempted(listener) \
-                if listener is not None else False
-            if preempted and step < cfg.train.train_steps:
-                manager.save(step, trainer.state, force=True)
-                manager.wait_until_finished()
-                log.warning("preempted (%s): checkpoint committed at step "
-                            "%d; exiting resumable", listener.reason(), step)
-                raise Preempted(step, listener.reason())
-            # fresh iterator per round: the ImageNet eval stream is one-pass
-            result = trainer.evaluate(make_eval_iterator(cfg, trainer.mesh),
-                                      cfg.eval.eval_batch_count)
-            best = max(best, result["precision"])
-            if writer:
-                writer.write_scalars(
-                    step, {"eval/precision": result["precision"],
-                           "eval/best_precision": best})
-            if is_chief():
-                print(f"eval @ step {step}: precision "
-                      f"{result['precision']:.4f} best {best:.4f}")
-        manager.save(step, trainer.state, force=True)
+        with _watchdog_session(cfg, writer, listener, trainer) \
+                as (publisher, watchdog):
+            _arm_watchdog_hooks(hooks, publisher)
+            while step < cfg.train.train_steps:
+                target = min(step + every, cfg.train.train_steps)
+                # phase flips to "train" at the first completed step via
+                # HeartbeatHook (NOT here): round 1's first step carries
+                # the XLA compile, which must stay in the unmonitored
+                # "init" phase
+                state, _ = trainer.train(train_iter, num_steps=target,
+                                         hooks=tuple(hooks), start_step=step,
+                                         stop_fn=stop_fn)
+                step = int(state.step)
+                preempted = collective_preempted(listener) \
+                    if listener is not None else False
+                if preempted and step < cfg.train.train_steps:
+                    if publisher is not None:
+                        publisher.set_phase("save")
+                    manager.save(step, trainer.state, force=True)
+                    manager.wait_until_finished()
+                    log.warning("preempted (%s): checkpoint committed at "
+                                "step %d; exiting resumable",
+                                listener.reason(), step)
+                    raise Preempted(step, listener.reason())
+                # fresh iterator per round: the ImageNet eval stream is
+                # one-pass
+                result = trainer.evaluate(
+                    make_eval_iterator(cfg, trainer.mesh),
+                    cfg.eval.eval_batch_count)
+                best = max(best, result["precision"])
+                if writer:
+                    writer.write_scalars(
+                        step, {"eval/precision": result["precision"],
+                               "eval/best_precision": best})
+                if is_chief():
+                    print(f"eval @ step {step}: precision "
+                          f"{result['precision']:.4f} best {best:.4f}")
+            if publisher is not None:
+                publisher.set_phase("save")
+            manager.save(step, trainer.state, force=True)
     finally:
         if listener is not None:
             listener.uninstall()
@@ -419,6 +614,18 @@ def main(argv=None):
         # 75 = checkpoint committed, relaunch to resume
         log.info("%s", p)
         sys.exit(RESUMABLE_EXIT_CODE)
+    except Exception:
+        if jax.process_count() > 1:
+            # a real failure with peers still alive: the run published a
+            # final phase="failed" beat (peers stop through their
+            # watchdogs) — exit hard NOW. sys.exit would run atexit's
+            # jax.distributed.shutdown, whose barrier waits on peers that
+            # are already leaving: measured minutes of hang per crash
+            log.exception("fatal error in a multi-process run; exiting 1 "
+                          "without the distributed shutdown barrier")
+            logging.shutdown()
+            os._exit(1)
+        raise
 
 
 if __name__ == "__main__":

@@ -33,6 +33,30 @@ def initialize_from_config(mesh_cfg) -> None:
     )
 
 
+def _enable_cpu_collectives() -> None:
+    """Pick a real cross-process collectives backend for the CPU platform.
+
+    jaxlib's default CPU collectives are single-process only ("Multiprocess
+    computations aren't implemented on the CPU backend"); gloo is the
+    multi-process implementation. Setting the env var is NOT enough — this
+    environment's sitecustomize drives jax.config at interpreter start, so
+    the flag must be flipped through jax.config before the backend
+    initializes. No-op on non-CPU platforms and when the operator already
+    chose an implementation."""
+    try:
+        # NOTE the asymmetric accessors: jax 0.4.37 exposes plain flags via
+        # config.read() only, context-managed ones via attribute only
+        if jax.config.read("jax_cpu_collectives_implementation") != "none":
+            return  # operator/site already chose one
+        platforms = jax.config.jax_platforms or ""
+        if platforms.split(",")[0].strip() != "cpu":
+            return
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        log.info("CPU platform multi-process: collectives set to gloo")
+    except Exception as e:  # unknown option on a different jaxlib — not fatal
+        log.warning("could not configure CPU collectives: %s", e)
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
@@ -43,6 +67,7 @@ def initialize(coordinator_address: Optional[str] = None,
       SLURM_NTASKS → num_processes, SLURM_PROCID → process_id,
       SLURM_STEP_NODELIST first node:8476 → coordinator.
     """
+    _enable_cpu_collectives()
     if coordinator_address is None and "SLURM_NTASKS" in os.environ and \
             int(os.environ["SLURM_NTASKS"]) > 1:
         num_processes = int(os.environ["SLURM_NTASKS"])

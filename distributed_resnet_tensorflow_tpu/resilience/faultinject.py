@@ -12,9 +12,20 @@ scripts/chaos_smoke.sh:
     or flip a byte in place (bit rot), leaving the manifest stale.
   * :func:`inject_nan` — wrap a training iterator so the N-th batch carries
     non-finite pixels, driving a genuine NaN loss through the real model.
-  * :func:`maybe_wrap_from_env` — env-var trigger (``DRT_FAULT_NAN_AT_BATCH``)
-    so subprocess tests and chaos scripts can inject through the unmodified
-    ``main.py`` CLI.
+  * :func:`inject_freeze` / :func:`inject_slow` — the watchdog's fault
+    menu (resilience/watchdog.py): a process that WEDGES at the N-th batch
+    (main thread blocked, heartbeats still flowing — the hung-collective
+    shape) and a process that keeps running but ``delay_secs`` slower per
+    batch (the straggler shape). A killed peer needs no wrapper: SIGKILL
+    via :func:`deliver_signal_after` with the child's pid.
+  * :func:`maybe_wrap_from_env` — env-var triggers
+    (``DRT_FAULT_NAN_AT_BATCH``, ``DRT_FAULT_FREEZE_AT_BATCH``,
+    ``DRT_FAULT_SLOW_BATCH_SECS``) so subprocess tests and chaos scripts
+    can inject through the unmodified ``main.py`` CLI. The watchdog
+    triggers accept an optional ``<process_id>:`` prefix ("1:40" = only
+    process 1 freezes at batch 40) — fault exactly one member of a
+    launched world even though the launcher hands every child the same
+    environment.
 
 Injection is opt-in and inert by default; none of this runs unless a test or
 operator asks for it.
@@ -25,6 +36,7 @@ import logging
 import os
 import signal as _signal
 import threading
+import time
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -32,6 +44,8 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 NAN_ENV_VAR = "DRT_FAULT_NAN_AT_BATCH"
+FREEZE_ENV_VAR = "DRT_FAULT_FREEZE_AT_BATCH"
+SLOW_ENV_VAR = "DRT_FAULT_SLOW_BATCH_SECS"
 
 
 # -- signals ----------------------------------------------------------------
@@ -152,20 +166,114 @@ def inject_nan(data_iter: Iterator[Dict], at_batch: int,
             yield batch
 
 
+# -- wedged / slow processes (watchdog fault cases) -------------------------
+
+def inject_freeze(data_iter: Iterator[Dict], at_batch: int,
+                  freeze_secs: float = 3600.0) -> Iterator[Dict]:
+    """Block (in the consumer's thread) before yielding the ``at_batch``-th
+    batch — the hung-collective / wedged-device shape: the main thread
+    stops making progress while the heartbeat daemon keeps beating, so
+    peers see a live-but-frozen process and the LOCAL watchdog sees a
+    stalled progress counter. ``freeze_secs`` bounds the nap so an
+    undetected freeze still ends (tests/CI must never rely on that)."""
+    if at_batch < 1:
+        raise ValueError(f"at_batch is 1-based, got {at_batch}")
+    count = 0
+    for batch in data_iter:
+        count += 1
+        if count == at_batch:
+            log.warning("fault injection: freezing before batch %d for "
+                        "up to %.0fs", count, freeze_secs)
+            time.sleep(freeze_secs)
+        yield batch
+
+
+def inject_slow(data_iter: Iterator[Dict],
+                delay_secs: float) -> Iterator[Dict]:
+    """Delay every batch by ``delay_secs`` — the persistent-straggler
+    shape: the process keeps up with every collective, just late, which is
+    exactly what the watchdog's per-host step-rate accounting exists to
+    surface (``{"event": "straggler"}`` rows)."""
+    if delay_secs < 0:
+        raise ValueError(f"delay_secs must be >= 0, got {delay_secs}")
+    for batch in data_iter:
+        time.sleep(delay_secs)
+        yield batch
+
+
+def _parse_scoped(value: str, env_var: str,
+                  process_id: Optional[int]) -> Optional[str]:
+    """Parse ``"<value>"`` or ``"<pid>:<value>"``; returns the value when
+    this process is targeted, else None."""
+    if ":" in value:
+        target, _, rest = value.partition(":")
+        try:
+            if process_id != int(target):
+                return None
+        except ValueError:
+            log.warning("ignoring malformed %s=%r", env_var, value)
+            return None
+        return rest
+    return value
+
+
+def _scoped_env_value(environ, env_var: str, process_id: Optional[int],
+                      convert):
+    """The shared read→scope→convert path of the ``[pid:]value`` watchdog
+    faults; None when unset, scoped to another process, or malformed."""
+    raw = environ.get(env_var, "")
+    if not raw:
+        return None
+    scoped = _parse_scoped(raw, env_var, process_id)
+    if not scoped:
+        return None
+    try:
+        return convert(scoped)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", env_var, raw)
+        return None
+
+
 _nan_armed = False
+_freeze_armed = False
 
 
 def maybe_wrap_from_env(data_iter: Iterator[Dict],
                         env: Optional[Dict[str, str]] = None) -> Iterator[Dict]:
-    """Apply :func:`inject_nan` when ``DRT_FAULT_NAN_AT_BATCH`` is set to a
-    positive integer — the hook main.py's train source passes through so
-    subprocess tests / chaos scripts can inject without patching code.
+    """Apply the env-var-armed fault wrappers — the hook main.py's train
+    source passes through so subprocess tests / chaos scripts can inject
+    without patching code: ``DRT_FAULT_NAN_AT_BATCH=N`` (NaN images at
+    batch N), ``DRT_FAULT_FREEZE_AT_BATCH=[pid:]N`` (wedge at batch N),
+    ``DRT_FAULT_SLOW_BATCH_SECS=[pid:]S`` (S seconds extra per batch).
+    The optional ``pid:`` prefix scopes a fault to one process of a
+    multi-process world.
 
-    Arms at most ONCE per process: the NaN sentinel rebuilds the train
-    source after a rollback, and re-poisoning the rebuilt stream would turn
-    one injected fault into an unrecoverable run."""
-    global _nan_armed
-    value = (os.environ if env is None else env).get(NAN_ENV_VAR, "")
+    The NaN and freeze faults arm at most ONCE per process: the NaN
+    sentinel rebuilds the train source after a rollback, and re-poisoning
+    the rebuilt stream would turn one injected fault into an unrecoverable
+    run (for freeze, a recurring wedge at the same batch of the replayed
+    stream). The slow fault deliberately re-arms — it simulates a
+    persistently slow HOST, and the wrapper does not nest on rebuild."""
+    global _nan_armed, _freeze_armed
+    environ = os.environ if env is None else env
+    process_id = None
+    freeze_val = environ.get(FREEZE_ENV_VAR, "")
+    slow_val = environ.get(SLOW_ENV_VAR, "")
+    if ":" in freeze_val or ":" in slow_val:
+        import jax
+        process_id = jax.process_index()
+    at_batch = _scoped_env_value(environ, FREEZE_ENV_VAR, process_id, int)
+    if at_batch is not None and at_batch >= 1 and not _freeze_armed:
+        _freeze_armed = True
+        log.warning("fault injection armed: freeze at batch %d (%s)",
+                    at_batch, FREEZE_ENV_VAR)
+        data_iter = inject_freeze(data_iter, at_batch)
+    delay = _scoped_env_value(environ, SLOW_ENV_VAR, process_id, float)
+    if delay is not None and delay > 0:
+        log.warning("fault injection armed: +%.3fs per batch (%s)",
+                    delay, SLOW_ENV_VAR)
+        data_iter = inject_slow(data_iter, delay)
+    value = environ.get(NAN_ENV_VAR, "")
     if not value or _nan_armed:
         return data_iter
     _nan_armed = True

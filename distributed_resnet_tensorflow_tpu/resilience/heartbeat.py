@@ -1,0 +1,304 @@
+"""Heartbeats: per-process liveness beats over a pluggable transport.
+
+Synchronous SPMD training is exactly as reliable as its least reliable
+worker (Horovod, arXiv:1802.05799): when one host dies or wedges inside a
+collective, every peer blocks in that collective with NO runtime signal —
+the training loop cannot observe its own hang from inside. The heartbeat
+subsystem provides the out-of-band liveness channel the loop lacks:
+
+  * every process runs a :class:`HeartbeatPublisher` — a daemon thread that
+    publishes a :class:`Beat` (``{step, progress, phase, wall_time, ...}``)
+    every ``interval_secs`` REGARDLESS of what the main thread is doing.
+    A wedged process therefore keeps beating with a frozen ``progress``
+    (distinguishable hang), while a dead process stops beating entirely
+    (distinguishable host loss).
+  * the train loop / hooks feed the publisher at step boundaries
+    (``update``/``tick``) — cheap field writes under a lock, no I/O on the
+    hot path. The publisher also maintains the rolling per-step-time
+    estimate (EWMA) the watchdog derives its hang deadline from.
+  * transport is abstract (:class:`BeatTransport`); the file-based
+    implementation works over the shared run directory every SLURM/TPU-pod
+    deployment already has (same reliance as checkpoints). A socket/kv
+    backend can land later without touching publisher or watchdog.
+
+Consumed by resilience/watchdog.py; see docs/resilience.md for the
+detection/teardown story and the metrics.jsonl schemas.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+#: phases that mean "this process left the run on purpose" — peers must
+#: not flag them as lost (PHASE_FAILED is the loud exception: it marks a
+#: real error on that process, see watchdog escalation)
+PHASE_DONE = "done"
+PHASE_PREEMPTED = "preempted"
+PHASE_FAILED = "failed"
+DEPARTED_PHASES = (PHASE_DONE, PHASE_PREEMPTED, PHASE_FAILED)
+
+#: phases in which a stalled ``progress`` counter indicates a hang (init /
+#: compile / save are legitimately long and un-ticked)
+MONITORED_PHASES = ("train", "eval")
+
+
+@dataclasses.dataclass
+class Beat:
+    """One liveness report. ``progress`` is the monotonic counter hang
+    detection watches (train steps AND eval batches bump it — ``step``
+    alone would false-positive during evaluation); ``wall_time`` is
+    ``time.time()`` at publish so peers can age beats across hosts (NTP
+    assumed, same as every shared-filesystem timestamp)."""
+
+    process_id: int
+    pid: int
+    host: str
+    seq: int           # publisher iteration, monotonic per run
+    step: int          # last completed optimizer step
+    progress: int      # steps + eval batches; the liveness counter
+    phase: str         # init | train | eval_init | eval | save | poll |
+                       # done | preempted | failed (only train/eval are
+                       # hang-monitored, MONITORED_PHASES)
+    wall_time: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Beat":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+class BeatTransport:
+    """Abstract beat exchange: publish mine, read everyone's latest."""
+
+    def publish(self, beat: Beat) -> None:
+        raise NotImplementedError
+
+    def peers(self) -> Dict[int, Beat]:
+        """Latest beat per process id (including our own)."""
+        raise NotImplementedError
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+
+class FileBeatTransport(BeatTransport):
+    """Beats as one JSON file per process under a shared directory.
+
+    Writes are atomic (tmp + ``os.replace``) so readers never parse a torn
+    file; unparseable files are skipped (NFS clients without atomic rename
+    visibility), not fatal. At construction the process deletes its OWN
+    stale file from a previous run in the same dir, and ``peers`` ignores
+    any beat published before this transport existed — after a requeue the
+    dir still holds every OTHER process's previous-run files, and without
+    the epoch filter a fast-starting peer would read one (arbitrarily old,
+    possibly phase="failed") and fire a spurious teardown before the slow
+    peer's first beat lands. A filtered peer looks like "never beat in
+    this run", which the watchdog already treats as bootstrap territory.
+    Beats refresh every ``interval_secs``, so a live peer that started
+    before us becomes visible within one interval (NTP assumed, same as
+    beat aging).
+    """
+
+    def __init__(self, directory: str, process_id: int,
+                 wall_clock=time.time):
+        self.directory = directory
+        self.process_id = process_id
+        self._epoch = wall_clock()
+        os.makedirs(directory, exist_ok=True)
+        for final in (False, True):
+            try:
+                os.remove(self._path(process_id, final=final))
+            except OSError:
+                pass
+
+    def _path(self, pid: int, final: bool = False) -> str:
+        # final (departure) beats live in a SIDECAR file: the regular file
+        # is last-writer-wins, and a publisher thread stuck in a shared-FS
+        # stall past close()'s join timeout could otherwise land a stale
+        # phase="train" beat AFTER the final "done" — turning a clean
+        # departure into a spurious peer_lost 75 for the survivors
+        suffix = ".final.json" if final else ".json"
+        return os.path.join(self.directory, f"proc{pid}{suffix}")
+
+    def publish(self, beat: Beat) -> None:
+        path = self._path(beat.process_id,
+                          final=beat.phase in DEPARTED_PHASES)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(beat.to_dict(), f)
+            os.replace(tmp, path)
+        except OSError as e:
+            # a full/flaky shared FS must degrade heartbeats, not kill
+            # training — the watchdog treats missing beats conservatively
+            log.warning("heartbeat publish failed: %s", e)
+
+    def peers(self) -> Dict[int, Beat]:
+        out: Dict[int, Beat] = {}
+        finals: Dict[int, Beat] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("proc") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    beat = Beat.from_dict(json.load(f))
+            except (OSError, ValueError, TypeError):
+                continue  # mid-replace on a non-atomic FS, or junk
+            if beat.wall_time < self._epoch:
+                continue  # previous-run leftover (requeue): see docstring
+            if name.endswith(".final.json"):
+                finals[beat.process_id] = beat
+            else:
+                out[beat.process_id] = beat
+        out.update(finals)  # a departure statement outranks any live beat
+        return out
+
+
+class HeartbeatPublisher:
+    """Daemon publishing thread + the hot-path state it reports.
+
+    The TRAIN LOOP side (``update``/``tick``) only writes fields under a
+    lock — no file I/O, no syscalls beyond a clock read. The PUBLISHER
+    THREAD serializes a beat every ``interval_secs``. The split is the
+    whole point: the thread keeps beating while the main thread is stuck
+    in a collective, which is precisely when liveness reporting matters.
+    """
+
+    #: EWMA weight for the rolling per-step-time estimate
+    EWMA_ALPHA = 0.3
+
+    def __init__(self, transport: BeatTransport, process_id: int,
+                 interval_secs: float = 1.0,
+                 clock=time.monotonic, wall_clock=time.time):
+        self.transport = transport
+        self.process_id = process_id
+        self.interval_secs = max(0.05, interval_secs)
+        self._clock = clock
+        self._wall = wall_clock
+        self._lock = threading.Lock()
+        self._step = 0
+        self._progress = 0
+        self._phase = "init"
+        self._seq = 0
+        self._last_progress_t = clock()
+        self._prev_update_t: Optional[float] = None
+        self._prev_step: Optional[int] = None
+        self._step_stride = 1
+        self._ewma_step_secs: Optional[float] = None
+        # True after any tick()/set_phase() — i.e. non-step activity (eval
+        # round, save, poll) happened since the last step boundary, so the
+        # NEXT step delta spans that pause and must not enter the EWMA
+        self._interlude = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._host = socket.gethostname()
+        self._pid = os.getpid()
+
+    # -- hot path (train loop / hooks) --------------------------------------
+    def update(self, step: Optional[int] = None,
+               phase: Optional[str] = None) -> None:
+        """Record a step boundary (and/or phase change). Maintains the
+        rolling per-step-time EWMA; the FIRST step delta is discarded — it
+        includes compilation and would poison the estimate for the whole
+        run — and so is the first delta after any tick()/set_phase()
+        interlude (eval round, save): that delta spans the whole pause,
+        and one 30-minute eval folded in at alpha 0.3 would inflate the
+        hang deadline by hours."""
+        now = self._clock()
+        with self._lock:
+            if phase is not None:
+                self._phase = phase
+            if step is not None and step != self._step:
+                if self._prev_update_t is not None and \
+                        self._prev_step is not None and step > self._prev_step:
+                    dt = (now - self._prev_update_t) / (step - self._prev_step)
+                    # progress only ticks at this granularity (the fused
+                    # loop's steps_per_loop): hang deadlines must scale by
+                    # it, or a healthy 64-step scan outlives a 10×-one-step
+                    # deadline and reads as a hang
+                    self._step_stride = step - self._prev_step
+                    # skip the compile-laden first delta and post-pause deltas
+                    if self._prev_step > 0 and not self._interlude:
+                        self._ewma_step_secs = dt if self._ewma_step_secs is None \
+                            else (1 - self.EWMA_ALPHA) * self._ewma_step_secs \
+                            + self.EWMA_ALPHA * dt
+                self._interlude = False
+                self._prev_update_t = now
+                self._prev_step = step
+                self._step = step
+                self._progress += 1
+                self._last_progress_t = now
+
+    def tick(self, phase: Optional[str] = None) -> None:
+        """Liveness bump without a step advance (eval batches, long host
+        side work) — keeps hang detection honest outside the train loop."""
+        now = self._clock()
+        with self._lock:
+            if phase is not None:
+                self._phase = phase
+            self._interlude = True
+            self._progress += 1
+            self._last_progress_t = now
+
+    def set_phase(self, phase: str) -> None:
+        with self._lock:
+            self._phase = phase
+            self._interlude = True
+
+    def snapshot(self) -> dict:
+        """Local state for the watchdog (no I/O)."""
+        with self._lock:
+            return {"step": self._step, "progress": self._progress,
+                    "phase": self._phase,
+                    "last_progress_t": self._last_progress_t,
+                    "ewma_step_secs": self._ewma_step_secs,
+                    "step_stride": self._step_stride}
+
+    # -- publisher thread ----------------------------------------------------
+    def _beat(self) -> Beat:
+        with self._lock:
+            self._seq += 1
+            return Beat(process_id=self.process_id, pid=self._pid,
+                        host=self._host, seq=self._seq, step=self._step,
+                        progress=self._progress, phase=self._phase,
+                        wall_time=self._wall())
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_secs):
+            self.transport.publish(self._beat())
+
+    def start(self) -> "HeartbeatPublisher":
+        if self._thread is None:
+            self.transport.publish(self._beat())  # beat 1 lands immediately
+            self._thread = threading.Thread(
+                target=self._run, name="drt-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self, final_phase: str = PHASE_DONE) -> None:
+        """Stop the thread and publish one last beat whose phase tells the
+        peers HOW we left: done/preempted = clean departure (don't flag),
+        failed = this process died on a real error (peers stop resumable,
+        the supervisor reports the real failure)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_secs + 1.0)
+            self._thread = None
+        with self._lock:
+            self._phase = final_phase
+        self.transport.publish(self._beat())

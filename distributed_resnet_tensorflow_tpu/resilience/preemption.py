@@ -147,6 +147,15 @@ class PreemptionListener:
             signal.signal(signum, signal.SIG_DFL)
         signal.raise_signal(signum)
 
+    def request_stop(self, reason: str) -> None:
+        """Programmatic stop request (no signal): the watchdog's graceful
+        escalation path (resilience/watchdog.py) and any other subsystem
+        that wants the loop to stop at the next step boundary and exit
+        resumable. Thread-safe; first reason wins."""
+        if self._reason is None:
+            self._reason = reason
+        self._event.set()
+
     # -- polling API (train-loop hot path: one Event.is_set + a clock read) -
     def should_stop(self) -> bool:
         if self._event.is_set():

@@ -1,0 +1,418 @@
+"""Distributed health watchdog: hang detection, peer loss, stragglers.
+
+PR 1 made a single process survive signals, torn checkpoints and NaNs; this
+module covers the failures that involve OTHER processes. Synchronous SPMD
+training blocks inside a collective when any peer dies or wedges — the
+survivors hang until the SLURM wall clock expires, billing an entire
+allocation for nothing (the straggler/host-loss regime of arXiv:1811.05233).
+The watchdog turns that into a bounded, requeue-able event:
+
+  detection (one daemon thread per process, ticking every ``interval_secs``;
+  the zero-I/O local-hang check runs every tick, while the shared-FS beat
+  scan — N file opens per poll, O(N²) fleet-wide — runs only every
+  ``max(interval_secs, peer_timeout_secs/4)`` so detection never taxes the
+  filesystem the checkpoints live on):
+    (a) **peer loss** — a peer's beats (resilience/heartbeat.py) stop:
+        its latest beat is older than ``peer_timeout_secs`` and its last
+        phase was not a deliberate departure (done/preempted).
+    (b) **hang** — OUR main thread stops making progress: the publisher's
+        ``progress`` counter (train steps + eval batches) is stalled past
+        ``max(min_step_timeout_secs, step_timeout_scale × rolling
+        per-step-time EWMA)`` while in a monitored phase. The rolling
+        deadline means a 50 ms/step CIFAR run is declared hung in seconds,
+        a 20 s/step 32k-batch run is not declared hung during a slow step.
+    (c) **peer failure** — a peer published a final ``phase="failed"``
+        beat: it died on a real error; survivors must stop but the launcher
+        must NOT requeue-mask the failure.
+    (d) **stragglers** — per-host step-rate skew over a rolling window,
+        exported as ``{"event": "straggler"}`` metrics rows (accounting
+        only; no teardown).
+
+  escalation for (a)/(b): log + metrics row → request a graceful stop
+  through the existing preemption stop path (works when peers are still
+  responsive: every process stops at the same boundary, commits the
+  preemption checkpoint, exits 75) → after ``grace_secs``, if the process
+  is still here (main thread stuck inside a collective that will never
+  complete), ``os._exit(75)`` FROM THE DAEMON THREAD — the launcher
+  supervisor (launch.py) and the SLURM shim read 75 as "requeue and
+  resume". For (c) the hard exit code is 1: a real failure propagates as a
+  real failure. Before exiting the verdict is re-verified so a transient
+  blip (GC pause, FS hiccup resuming beats) cancels the teardown.
+
+See docs/resilience.md for the exit-code contract and the metrics.jsonl
+schemas; tests/test_watchdog.py drives every path with a fake transport
+and clock, tests/test_resilience.py kills a live 2-process run.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from .heartbeat import (Beat, BeatTransport, DEPARTED_PHASES,
+                        HeartbeatPublisher, MONITORED_PHASES, PHASE_FAILED)
+from .preemption import RESUMABLE_EXIT_CODE
+
+log = logging.getLogger(__name__)
+
+#: exit code for a peer that died on a real (non-resumable) error
+FAILURE_EXIT_CODE = 1
+
+
+def watchdog_enabled(wd_cfg, process_count: int) -> bool:
+    """Resolve the ``resilience.watchdog.enabled`` tri-state: auto = on iff
+    the run actually has peers (single-process runs have nothing to watch —
+    a local hang there still surfaces via the operator/SLURM timeout)."""
+    if wd_cfg.enabled == "on":
+        return True
+    if wd_cfg.enabled == "off":
+        return False
+    if wd_cfg.enabled != "auto":
+        raise ValueError(
+            f"unknown resilience.watchdog.enabled {wd_cfg.enabled!r}")
+    return process_count > 1
+
+
+class Watchdog:
+    """One daemon detection thread; all knobs injectable for tests.
+
+    ``request_stop(reason)`` is the graceful path (PreemptionListener's
+    stop flag); ``exit_fn`` is the hard path (``os._exit`` — must be safe
+    from a non-main thread with the main thread wedged, which rules out
+    sys.exit/atexit). ``writer`` (chief-only by convention) receives the
+    typed metrics rows; every process still logs.
+    """
+
+    def __init__(self, transport: BeatTransport,
+                 publisher: HeartbeatPublisher,
+                 process_id: int, num_processes: int, cfg,
+                 writer=None,
+                 request_stop: Optional[Callable[[str], None]] = None,
+                 clock=time.monotonic, wall_clock=time.time,
+                 exit_fn=os._exit):
+        self.transport = transport
+        self.publisher = publisher
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.cfg = cfg
+        self.writer = writer
+        self.request_stop = request_stop
+        self._clock = clock
+        self._wall = wall_clock
+        self._exit_fn = exit_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._disarmed = False
+        # verdict state: (kind, exit_code, detail, fired_at_monotonic)
+        self._fired: Optional[tuple] = None
+        # straggler accounting: pid -> deque[(wall_time, step)]
+        self._history: Dict[int, deque] = {}
+        self._last_export = self._clock()
+        # peer-loss only needs peer_timeout_secs granularity, so the
+        # shared-FS beat scan (N opens per poll; O(N^2) fleet-wide) runs at
+        # a fraction of the timeout instead of every tick — only the
+        # zero-I/O local-hang check needs the interval_secs cadence
+        self._peer_poll_secs = max(cfg.interval_secs,
+                                   cfg.peer_timeout_secs / 4.0)
+        self._last_peer_poll = float("-inf")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="drt-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def disarm(self) -> None:
+        """The run is leaving through a legitimate path (finished, preempted,
+        failing with its own traceback) — the watchdog must not hard-exit
+        out from under the orderly shutdown."""
+        self._disarmed = True
+
+    def close(self) -> None:
+        self.disarm()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.cfg.interval_secs + 1.0)
+            self._thread = None
+
+    def fired(self) -> Optional[str]:
+        """The detection verdict ("peer_lost" | "hang" | "peer_failed"),
+        or None."""
+        return self._fired[0] if self._fired else None
+
+    # -- detection loop ------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.interval_secs):
+            try:
+                self._tick(self._clock())
+            except Exception:  # detection must never kill the run itself
+                log.exception("watchdog tick failed")
+
+    def _tick(self, now: float) -> None:
+        # read the beat files only at the slower peer-poll cadence — except
+        # while a verdict is pending, when grace re-verification wants the
+        # freshest beats it can get (firing is rare; the cost is irrelevant)
+        peers: Optional[Dict[int, Beat]] = None
+        if self._fired is not None or \
+                now - self._last_peer_poll >= self._peer_poll_secs:
+            peers = self._poll_peers(now)
+        wall_now = self._wall()
+        if self._fired is None and not self._disarmed:
+            verdict = (self._check_peers(peers, wall_now)
+                       if peers is not None else None) \
+                or self._check_local_hang(now)
+            if verdict is not None:
+                self._escalate(*verdict, now=now)
+        elif self._fired is not None:
+            self._maybe_exit(now, peers)
+        # chief-only: _export is a no-op without a writer, and the extra
+        # beat-directory scan it would force on every non-chief process
+        # is exactly the shared-FS tax detection must not impose
+        if self.writer is not None and \
+                now - self._last_export >= self.cfg.straggler_window_secs:
+            self._last_export = now
+            if peers is None:
+                peers = self._poll_peers(now)
+            self._export(peers, wall_now)
+
+    def _poll_peers(self, now: float) -> Dict[int, Beat]:
+        peers = self.transport.peers()
+        self._last_peer_poll = now
+        if self.writer is not None:  # history only feeds _export
+            self._record_history(peers, self._wall())
+        return peers
+
+    # -- (a)/(c): peers ------------------------------------------------------
+    def _check_peers(self, peers: Dict[int, Beat],
+                     wall_now: float) -> Optional[tuple]:
+        # scan EVERY peer before answering: a fatal `failed` beat must win
+        # over another peer's mere staleness, or the 75 would requeue-mask
+        # the real failure under SLURM's max-task-code aggregation
+        lost: Optional[tuple] = None
+        for pid in range(self.num_processes):
+            if pid == self.process_id:
+                continue
+            beat = peers.get(pid)
+            if beat is None:
+                # never beat in THIS run: bootstrap failures are the
+                # distributed-init retry's problem, not ours — flagging
+                # here would race every process's startup
+                continue
+            if beat.phase == PHASE_FAILED:
+                return ("peer_failed", FAILURE_EXIT_CODE,
+                        f"process {pid} (host {beat.host}) reported a fatal "
+                        f"error at step {beat.step}")
+            if beat.phase in DEPARTED_PHASES:
+                continue
+            age = wall_now - beat.wall_time
+            if lost is None and age > self.cfg.peer_timeout_secs:
+                lost = ("peer_lost", RESUMABLE_EXIT_CODE,
+                        f"process {pid} (host {beat.host}, pid {beat.pid}) "
+                        f"last beat {age:.1f}s ago at step {beat.step} "
+                        f"phase {beat.phase!r}")
+        return lost
+
+    # -- (b): local hang -----------------------------------------------------
+    def _hang_deadline(self, snap: dict) -> float:
+        est = snap.get("ewma_step_secs")
+        if est:
+            # progress ticks once per fused-loop boundary, not per step —
+            # the deadline is per UPDATE: est × stride × scale, or a
+            # healthy steps_per_loop=64 scan would read as a hang
+            stride = max(1, snap.get("step_stride") or 1)
+            return max(self.cfg.min_step_timeout_secs,
+                       self.cfg.step_timeout_scale * est * stride)
+        return self.cfg.min_step_timeout_secs
+
+    def _check_local_hang(self, now: float) -> Optional[tuple]:
+        snap = self.publisher.snapshot()
+        if snap["phase"] not in MONITORED_PHASES:
+            return None  # init/compile/save legitimately make no progress
+        stalled = now - snap["last_progress_t"]
+        deadline = self._hang_deadline(snap)
+        if stalled > deadline:
+            est = snap.get("ewma_step_secs")
+            return ("hang", RESUMABLE_EXIT_CODE,
+                    f"no progress for {stalled:.1f}s at step {snap['step']} "
+                    f"phase {snap['phase']!r} (deadline {deadline:.1f}s"
+                    + (f", rolling step time {est:.3f}s" if est else "")
+                    + ")")
+        return None
+
+    # -- escalation ----------------------------------------------------------
+    def _escalate(self, kind: str, code: int, detail: str,
+                  now: float) -> None:
+        self._fired = (kind, code, detail, now)
+        log.error("watchdog: %s — %s; requesting coordinated stop, hard "
+                  "exit %d in %.1fs if the step loop is stuck",
+                  kind, detail, code, self.cfg.grace_secs)
+        self._write_event(kind, {"detail": detail, "exit_code": code,
+                                 "grace_secs": self.cfg.grace_secs})
+        if self.request_stop is not None:
+            try:
+                self.request_stop(kind)
+            except Exception:  # pragma: no cover - stop path best effort
+                log.exception("watchdog: graceful stop request failed")
+
+    def _fresh_verdict(self, kind: str, code: int, detail: str,
+                       peers: Dict[int, Beat], now: float) -> Optional[tuple]:
+        """Re-derive the verdict at grace expiry. The situation may have
+        CHANGED during the window — notably a peer publishing a final
+        ``failed`` beat after we fired ``peer_lost`` must upgrade the exit
+        to the failure code, or the SLURM max-task-code aggregation would
+        requeue-mask the real error under our 75."""
+        if kind == "peer_failed":
+            # a published fatal error does not un-happen (and the beat
+            # file can vanish with its host — don't re-require it)
+            return (kind, code, detail)
+        fresh = self._check_peers(peers, self._wall())
+        if fresh is None and kind == "hang":
+            fresh = self._check_local_hang(now)
+        return fresh
+
+    def _maybe_exit(self, now: float, peers: Dict[int, Beat]) -> None:
+        kind, code, detail, fired_at = self._fired
+        if self._disarmed:
+            return
+        if now - fired_at < self.cfg.grace_secs:
+            return
+        fresh = self._fresh_verdict(kind, code, detail, peers, now)
+        if fresh is None:
+            # transient blip (GC pause, FS hiccup): cancel the teardown.
+            # The graceful stop request stays set — stopping resumable on
+            # a false alarm is safe; dying on one is not.
+            log.warning("watchdog: %s cleared within the grace window "
+                        "(%s) — teardown cancelled", kind, detail)
+            self._write_event("watchdog_cleared", {"kind": kind})
+            self._fired = None
+            return
+        # the coordinated stop may be succeeding RIGHT NOW even though the
+        # verdict still holds (a lost peer's beats stay stale forever): if
+        # the main thread is inside the final checkpoint save the stop
+        # path exists to commit, exiting would tear that very save.
+        # Bounded — a save wedged on the dead peer still dies at the cap.
+        if self.publisher.snapshot()["phase"] == "save" and \
+                now - fired_at < max(self.cfg.grace_secs,
+                                     self.cfg.min_step_timeout_secs):
+            return
+        self.exit_now(*fresh)
+
+    def exit_now(self, kind: str, code: int, detail: str) -> None:
+        """Hard teardown: flush observability, then ``os._exit`` — the only
+        exit that works from a daemon thread while the main thread is wedged
+        in a collective (sys.exit would run atexit, whose
+        jax.distributed.shutdown barrier blocks on the very peers that are
+        gone)."""
+        if self._disarmed:
+            # the main thread disarmed while the daemon was inside the
+            # (slow, shared-FS) verdict re-check: the run is leaving
+            # through an orderly path — exiting now would 75 a run that
+            # actually completed
+            log.warning("watchdog: %s verdict overtaken by an orderly "
+                        "shutdown — exit suppressed (%s)", kind, detail)
+            return
+        log.error("watchdog: %s — exiting %d for the launcher/SLURM requeue "
+                  "contract (%s)", kind, code, detail)
+        self._write_event("watchdog_exit", {"kind": kind, "exit_code": code,
+                                            "detail": detail})
+        if self.writer is not None:
+            try:
+                self.writer.flush()
+            except Exception:  # pragma: no cover
+                pass
+        logging.shutdown()
+        self._exit_fn(code)
+
+    # -- exception-path classification --------------------------------------
+    def failure_verdict(self, wait_secs: Optional[float] = None,
+                        poll_secs: float = 0.25) -> Optional[tuple]:
+        """Called from the MAIN thread after a collective/runtime error: was
+        it caused by a peer dying? Gloo/coordination errors surface within
+        milliseconds of a peer's death — before its beats are stale — so
+        this polls up to ``wait_secs`` (default: peer_timeout + 2 beat
+        intervals) for the beats to confirm. Returns (kind, exit_code,
+        detail) or None (no peer evidence: the error is OURS)."""
+        if self._fired is not None:
+            return self._fired[:3]
+        if wait_secs is None:
+            wait_secs = self.cfg.peer_timeout_secs + 2 * self.cfg.interval_secs
+        deadline = self._clock() + wait_secs
+        while True:
+            verdict = self._check_peers(self.transport.peers(), self._wall())
+            if verdict is not None:
+                self._write_event(verdict[0], {
+                    "detail": verdict[2], "exit_code": verdict[1],
+                    "via": "collective_error"})
+                return verdict
+            if self._clock() >= deadline:
+                return None
+            time.sleep(poll_secs)
+
+    # -- (d): straggler accounting + heartbeat export ------------------------
+    def _record_history(self, peers: Dict[int, Beat],
+                        wall_now: float) -> None:
+        horizon = 2 * self.cfg.straggler_window_secs
+        for pid, beat in peers.items():
+            hist = self._history.setdefault(pid, deque())
+            if not hist or beat.wall_time > hist[-1][0]:
+                hist.append((beat.wall_time, beat.step))
+            while hist and hist[0][0] < wall_now - horizon:
+                hist.popleft()
+
+    def _rates(self, wall_now: float) -> Dict[int, float]:
+        out: Dict[int, float] = {}
+        cutoff = wall_now - self.cfg.straggler_window_secs
+        for pid, hist in self._history.items():
+            window = [(t, s) for t, s in hist if t >= cutoff]
+            if len(window) >= 2 and window[-1][0] > window[0][0]:
+                out[pid] = (window[-1][1] - window[0][1]) / \
+                    (window[-1][0] - window[0][0])
+        return out
+
+    def _export(self, peers: Dict[int, Beat], wall_now: float) -> None:
+        if self.writer is None or not peers:
+            return
+        hosts = {str(pid): {"step": b.step, "progress": b.progress,
+                            "phase": b.phase, "host": b.host,
+                            "age_secs": round(wall_now - b.wall_time, 3)}
+                 for pid, b in sorted(peers.items())}
+        self._write_event("heartbeat", {"hosts": hosts})
+        rates = self._rates(wall_now)
+        if not rates:
+            return
+        ordered = sorted(rates.values())
+        mid = len(ordered) // 2
+        # true median: the upper-middle element alone would be the MAX in
+        # a 2-host world, flagging against the fastest host instead
+        median = ordered[mid] if len(ordered) % 2 else \
+            (ordered[mid - 1] + ordered[mid]) / 2.0
+        max_step = max(b.step for b in peers.values())
+        flagged = sorted(
+            pid for pid, r in rates.items()
+            if median > 0 and r > 0 and median / r >= self.cfg.straggler_ratio)
+        for pid in flagged:
+            log.warning(
+                "watchdog: process %d is a straggler: %.2f steps/s vs "
+                "median %.2f over the last %.0fs window", pid, rates[pid],
+                median, self.cfg.straggler_window_secs)
+        self._write_event("straggler", {
+            "window_secs": self.cfg.straggler_window_secs,
+            "rates": {str(pid): round(r, 4) for pid, r in sorted(rates.items())},
+            "median": round(median, 4),
+            "lag_steps": {str(pid): int(max_step - b.step)
+                          for pid, b in sorted(peers.items())},
+            "flagged": flagged,
+        })
+
+    def _write_event(self, event: str, payload: dict) -> None:
+        if self.writer is None:
+            return
+        try:
+            self.writer.write_event(event, payload)
+        except Exception:  # pragma: no cover - observability best effort
+            log.exception("watchdog: metrics event %r failed", event)

@@ -139,6 +139,48 @@ class InputStagesHook(_CadenceHook):
                                     {"step": int(step), "stages": snap})
 
 
+class CorruptRecordsHook(_CadenceHook):
+    """Export the corrupt-TFRecord tally (data/tfrecord.corrupt_records) to
+    metrics.jsonl as ``{"event": "corrupt_record"}`` rows — one row per
+    cadence WHEN the count advanced, carrying the cumulative count, the
+    per-reason breakdown, and the most recent offenders. Dataset bit rot
+    thereby shows up in run telemetry instead of only in a decode worker's
+    log file."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+        self._exported_count = 0
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        from ..data.tfrecord import corrupt_records
+        snap = corrupt_records.snapshot()
+        if snap["count"] > self._exported_count:
+            self._exported_count = snap["count"]
+            self.writer.write_event("corrupt_record",
+                                    {"step": int(step), **snap})
+
+
+class HeartbeatHook:
+    """Feed the heartbeat publisher at every step boundary
+    (resilience/heartbeat.py): one locked field write, no I/O — the
+    publisher's daemon thread does the actual beat. Runs on EVERY process
+    (unlike the chief-only observability hooks): peer-loss detection needs
+    every host beating. Also maintains the rolling per-step-time estimate
+    the watchdog derives its hang deadline from, which is why this hook is
+    unthrottled — a cadence would quantize the estimate."""
+
+    def __init__(self, publisher):
+        self.publisher = publisher
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        self.publisher.update(step=step, phase="train")
+
+
 class CheckpointHook:
     """Save via CheckpointManager on its step/time policy.
 
@@ -147,10 +189,18 @@ class CheckpointHook:
     guard's next check, and a committed NaN checkpoint (valid manifest!)
     would then be what every rollback restores — defeating the recovery in
     resilience/sentinel.py. The finite check runs only when the cadence
-    actually fires, so the hot path pays no device sync."""
+    actually fires, so the hot path pays no device sync.
 
-    def __init__(self, manager):
+    ``heartbeat`` (assigned by main.py when the watchdog is armed) flips
+    the phase to the unmonitored "save" around the save: a large state on
+    a slow shared FS can legitimately stall the main thread past the hang
+    deadline, and the watchdog must not 75 a healthy run mid-checkpoint.
+    The phase flip also marks an EWMA interlude, so the save time never
+    inflates the rolling step-time estimate."""
+
+    def __init__(self, manager, heartbeat=None):
         self.manager = manager
+        self.heartbeat = heartbeat
 
     def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
         # gate first so the finite check (a device sync via float()) is
@@ -163,7 +213,14 @@ class CheckpointHook:
             log.warning("skipping checkpoint at step %d: non-finite %s "
                         "(the NaN guard will handle recovery)", step, bad)
             return
-        self.manager.maybe_save(step, state)
+        if self.heartbeat is not None:
+            self.heartbeat.set_phase("save")
+            try:
+                self.manager.maybe_save(step, state)
+            finally:
+                self.heartbeat.set_phase("train")
+        else:
+            self.manager.maybe_save(step, state)
 
 
 class NanGuardHook(_CadenceHook):

@@ -325,6 +325,12 @@ class Trainer:
         self._jitted_idx = None
         self._jitted_idx_multi = None
         self.state: Optional[TrainState] = None
+        # optional resilience/heartbeat.HeartbeatPublisher (set by
+        # main.run_train when the watchdog is enabled): evaluate() ticks it
+        # per eval batch so hang detection stays live outside the train
+        # loop — eval makes no optimizer-step progress, and without ticks a
+        # long eval round would read as a wedged process
+        self.heartbeat = None
         ct = cfg.data.coalesced_transfer
         if ct not in ("auto", "on", "off"):
             raise ValueError(f"unknown coalesced_transfer setting {ct!r}")
@@ -752,8 +758,16 @@ class Trainer:
         # accumulate ON DEVICE (tiny async adds) and pull once at the end —
         # a per-batch int() would sync host<->device every eval step
         totals = None
+        hb = self.heartbeat
         try:
-            for _ in range(num_batches):
+            for i in range(num_batches):
+                if hb is not None:
+                    # batch 0 carries the eval step's XLA compile, which
+                    # can legitimately exceed the hang deadline — keep it
+                    # in an unmonitored phase, exactly like the train
+                    # path's "init" (a mid-compile hard-exit 75 would
+                    # requeue-loop the job); monitoring arms at batch 1
+                    hb.tick(phase="eval_init" if i == 0 else "eval")
                 try:
                     batch = next(dev_iter)
                 except StopIteration:

@@ -119,6 +119,21 @@ class DataConfig:
     # reused host staging buffers; must cover the transfers in flight
     # (transfer_depth + the one behind the current put)
     staging_ring: int = 4
+    # tolerate this many corrupt/truncated TFRecord records per process
+    # before raising (each skip is a counted warning + a
+    # {"event": "corrupt_record"} metrics row — data/tfrecord.py); 0 =
+    # strict, any corruption raises immediately. Tolerant BY DESIGN: a
+    # multi-day run must not die on one rotten byte, and mass corruption
+    # (a storage incident) still raises once the budget is spent — set 0
+    # to restore the old fail-fast behavior. Truncation is always
+    # detected; CRC-detectable corruption (flipped payload bytes) only
+    # with verify_crc=True below
+    max_corrupt_records: int = 10
+    # verify TFRecord CRCs on the python reader path. Costs a pure-python
+    # CRC32C pass over every record — reserve for suspect storage; off,
+    # only truncated records/headers are detected (and skipped/counted
+    # under max_corrupt_records)
+    verify_crc: bool = False
     # eval pipeline
     eval_batch_size: int = 100        # reference resnet_cifar_eval.py batch of 100
 
@@ -213,6 +228,41 @@ class CheckpointConfig:
 
 
 @dataclass
+class WatchdogConfig:
+    """Distributed health watchdog (resilience/watchdog.py +
+    resilience/heartbeat.py): per-process heartbeat daemon + detection of
+    dead peers, hung steps, and stragglers, with coordinated teardown
+    (graceful stop when peers respond, hard exit 75 when the step loop is
+    wedged in a collective). docs/resilience.md has the full story."""
+
+    # auto = on iff the run has >1 process (single-process runs have no
+    # peers to watch and no collective to hang in)
+    enabled: str = "auto"             # auto | on | off
+    # heartbeat publish cadence AND watchdog poll cadence
+    interval_secs: float = 1.0
+    # a peer whose latest beat is older than this is declared lost
+    peer_timeout_secs: float = 20.0
+    # hang deadline = max(min_step_timeout_secs,
+    #                     step_timeout_scale * rolling per-step-time EWMA)
+    step_timeout_scale: float = 10.0
+    min_step_timeout_secs: float = 120.0
+    # window between requesting a graceful coordinated stop and hard
+    # os._exit(75) when the main thread never reaches a stop poll
+    grace_secs: float = 10.0
+    # straggler accounting window (also the heartbeat/straggler
+    # metrics.jsonl export cadence)
+    straggler_window_secs: float = 30.0
+    # flag a host whose step rate is slower than the median by this factor
+    straggler_ratio: float = 1.5
+    # beat exchange directory; empty = <log_root>/heartbeats (must be on a
+    # filesystem all processes share, like the checkpoint dir). A
+    # standalone mode=eval job always gets an "eval"-scoped subdir (of
+    # this or of log_root) — its own jax world must not impersonate
+    # trainer process 0
+    heartbeat_dir: str = ""
+
+
+@dataclass
 class ResilienceConfig:
     """Fault-tolerance knobs (resilience/ subsystem; docs/resilience.md).
     The reference had none of this — failure handling was "SLURM restarts
@@ -239,6 +289,8 @@ class ResilienceConfig:
     verify_on_restore: bool = True
     # bounded-retry policy for checkpoint I/O (resilience/retry.py)
     io_retries: int = 3
+    # distributed health watchdog knobs (resilience.watchdog.*)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
 
 
 @dataclass
@@ -257,6 +309,10 @@ class EvalConfig:
     eval_once: bool = False
     poll_interval_secs: float = 60.0  # reference sleeps 60s between polls
     eval_dir: str = ""
+    # a polling evaluator skips damaged/vanished checkpoints; this bounds
+    # how many it may skip IN A ROW before exiting nonzero — a persistently
+    # broken checkpoint stream must page someone, not spin forever
+    max_consecutive_failures: int = 5
 
 
 @dataclass

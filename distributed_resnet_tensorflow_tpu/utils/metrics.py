@@ -105,6 +105,9 @@ class MetricsWriter:
         self.logdir = logdir
         os.makedirs(logdir, exist_ok=True)
         self._jsonl = open(os.path.join(logdir, filename), "a", buffering=1)
+        # the watchdog's detection thread writes events concurrently with
+        # the hook thread's scalars; serialize so rows never interleave
+        self._wlock = threading.Lock()
         self._tb = None
         if enable_tensorboard:
             try:
@@ -136,7 +139,8 @@ class MetricsWriter:
         rec = {"step": int(step), "time": time.time()}
         for k, v in scalars.items():
             rec[k] = float(v)
-        self._jsonl.write(json.dumps(rec) + "\n")
+        with self._wlock:
+            self._jsonl.write(json.dumps(rec) + "\n")
         if self._tb is not None:
             for k, v in scalars.items():
                 self._tb.add_scalar(k, float(v), int(step))
@@ -147,7 +151,8 @@ class MetricsWriter:
         the "event" key (read_metrics returns both kinds)."""
         rec = {"event": event, "time": time.time()}
         rec.update(payload)
-        self._jsonl.write(json.dumps(rec) + "\n")
+        with self._wlock:
+            self._jsonl.write(json.dumps(rec) + "\n")
 
     def flush(self) -> None:
         self._jsonl.flush()

@@ -1,16 +1,26 @@
 #!/bin/bash
 # Chaos smoke — run the fault-injection suite (resilience/faultinject.py):
-# signal delivery mid-run, torn/bit-rotted checkpoints, injected NaN loss.
-# Everything runs on the fake-CPU mesh (tests/conftest.py) — no accelerator
-# needed. It is the same set tier-1 runs (`-m "not slow"`); note that set
-# INCLUDES the @heavy SIGTERM kill-and-resume subprocess test (~1-2 min of
-# real training subprocesses on a 1-core host). For a seconds-fast pass,
-# add `-m "not slow and not heavy"`.
+# signal delivery mid-run, torn/bit-rotted checkpoints, injected NaN loss,
+# plus the watchdog cases (killed peer, frozen peer, straggler —
+# tests/test_watchdog.py + the subprocess kill-and-detect tests in
+# tests/test_resilience.py). Everything runs on the fake-CPU mesh
+# (tests/conftest.py) — no accelerator needed.
 #
-#   scripts/chaos_smoke.sh            # the tier-1 chaos set (incl. heavy)
+#   scripts/chaos_smoke.sh            # the tier-1 chaos set (incl. @heavy
+#                                     # multi-process subprocess tests,
+#                                     # ~minutes of real training children)
+#   scripts/chaos_smoke.sh --fast     # seconds-fast pre-merge gate:
+#                                     # -m "not slow and not heavy"
 #   scripts/chaos_smoke.sh -k nan     # just the NaN-recovery cases
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
-  -m "not slow" -p no:cacheprovider "$@"
+MARKS="not slow"
+if [[ "${1:-}" == "--fast" ]]; then
+  MARKS="not slow and not heavy"
+  shift
+fi
+
+exec env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_resilience.py tests/test_watchdog.py -q \
+  -m "$MARKS" -p no:cacheprovider "$@"

@@ -30,9 +30,10 @@ if [[ "${FRESH:-0}" == "1" && "${SLURM_RESTART_COUNT:-0}" == "0" ]]; then
 fi
 
 # Exit-code contract (docs/resilience.md): 75 (EX_TEMPFAIL) means the run
-# was preempted gracefully — a checkpoint at the last finished step is
-# committed and a relaunch with the same config resumes there. Requeue the
-# job instead of failing it; any other nonzero code is a real error.
+# stopped resumable — graceful preemption, or the health watchdog tore it
+# down after peer loss / a hung collective — with a committed checkpoint to
+# resume from. Requeue the job instead of failing it; any other nonzero
+# code is a real error.
 set +e
 srun --no-kill python -m distributed_resnet_tensorflow_tpu.main \
   --preset "$PRESET" \
@@ -40,6 +41,15 @@ srun --no-kill python -m distributed_resnet_tensorflow_tpu.main \
   "$@"
 rc=$?
 set -e
+
+# srun reports the HIGHEST task code. 137/143 (SIGKILL/SIGTERM death) is
+# the host-loss / OOM-kill shape: the surviving tasks exited 75 via their
+# watchdogs but the killed task's code wins the max — requeue those too
+# (MAX_REQUEUES bounds a genuinely crash-looping job).
+if [[ $rc -eq 137 || $rc -eq 143 ]]; then
+  echo "task killed by signal (exit $rc): treating as host loss, requeueing"
+  rc=75
+fi
 
 if [[ $rc -eq 75 ]]; then
   # CAVEAT: srun reports the HIGHEST task exit code, so one task failing

@@ -39,6 +39,155 @@ def test_tfrecord_corruption_detected(tmp_path):
         list(read_tfrecords(path, verify_crc=True))
 
 
+# ---------------------------------------------------------------------------
+# corrupt-record tolerance (data.max_corrupt_records)
+# ---------------------------------------------------------------------------
+
+def _fresh_stats():
+    from distributed_resnet_tensorflow_tpu.data.tfrecord import (
+        CorruptRecordStats)
+    return CorruptRecordStats()
+
+
+def test_tfrecord_bitrot_skipped_with_counted_warning(tmp_path):
+    """Bit rot mid-shard: the damaged record is skipped (framing intact),
+    every OTHER record still arrives, and the skip is tallied."""
+    path = str(tmp_path / "rot.tfrecord")
+    records = [b"alpha" * 10, b"bravo" * 10, b"charlie" * 10]
+    write_tfrecords(path, records)
+    raw = bytearray(open(path, "rb").read())
+    # flip one byte inside the SECOND record's payload:
+    # rec0 = 12B header + 50B payload + 4B crc = 66; rec1 payload at 66+12
+    raw[66 + 12 + 5] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    stats = _fresh_stats()
+    out = list(read_tfrecords(path, verify_crc=True, max_corrupt=5,
+                              stats=stats))
+    assert out == [records[0], records[2]]
+    snap = stats.snapshot()
+    assert snap["count"] == 1
+    assert snap["by_reason"] == {"corrupt data crc": 1}
+    assert snap["recent"][0]["file"] == "rot.tfrecord"
+
+
+def test_tfrecord_truncation_abandons_file_not_run(tmp_path):
+    """A torn shard tail (half-written record) ends THAT file with a
+    counted skip; strict mode still raises."""
+    path = str(tmp_path / "torn.tfrecord")
+    records = [b"one" * 20, b"two" * 20]
+    write_tfrecords(path, records)
+    size = len(open(path, "rb").read())
+    with open(path, "r+b") as f:
+        f.truncate(size - 30)  # tears the second record
+    stats = _fresh_stats()
+    out = list(read_tfrecords(path, max_corrupt=5, stats=stats))
+    assert out == [records[0]]
+    assert stats.snapshot()["by_reason"] == {"truncated record": 1}
+    with pytest.raises(IOError, match="truncated"):
+        list(read_tfrecords(path))  # max_corrupt=0: legacy strict behavior
+
+
+def test_tfrecord_bitrot_undetected_without_verify_crc(tmp_path):
+    """The documented tradeoff of the default verify_crc=False path:
+    truncation is always caught, but flipped payload bytes pass through
+    unflagged — catching those needs data.verify_crc=true (a python
+    CRC32C pass per record)."""
+    path = str(tmp_path / "rot.tfrecord")
+    records = [b"alpha" * 10, b"bravo" * 10]
+    write_tfrecords(path, records)
+    raw = bytearray(open(path, "rb").read())
+    raw[12 + 5] ^= 0xFF  # flip a byte inside record 0's payload
+    open(path, "wb").write(bytes(raw))
+    stats = _fresh_stats()
+    out = list(read_tfrecords(path, max_corrupt=5, stats=stats))
+    assert len(out) == 2 and out[0] != records[0]   # damage flows through
+    assert stats.snapshot()["count"] == 0
+    out = list(read_tfrecords(path, verify_crc=True, max_corrupt=5,
+                              stats=stats))
+    assert out == [records[1]]                       # caught with CRCs on
+    assert stats.snapshot()["by_reason"] == {"corrupt data crc": 1}
+
+
+def test_tfrecord_corrupt_budget_exhaustion_raises(tmp_path):
+    """The tolerance is bounded: when the per-process tally exceeds
+    max_corrupt, the reader raises — mass corruption is a storage
+    incident, not noise."""
+    stats = _fresh_stats()
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"rot{i}.tfrecord")
+        write_tfrecords(p, [b"payload-abc"])
+        raw = bytearray(open(p, "rb").read())
+        raw[14] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        paths.append(p)
+    list(read_tfrecords(paths[0], verify_crc=True, max_corrupt=2,
+                        stats=stats))
+    list(read_tfrecords(paths[1], verify_crc=True, max_corrupt=2,
+                        stats=stats))
+    with pytest.raises(IOError, match="max_corrupt_records"):
+        list(read_tfrecords(paths[2], verify_crc=True, max_corrupt=2,
+                            stats=stats))
+
+
+def test_tfrecord_partial_trailing_header_is_eof_in_strict_mode(tmp_path):
+    """The legacy reader treated 1-11 trailing bytes (torn mid-header) as
+    silent EOF; strict mode (max_corrupt=0) must keep accepting files it
+    always accepted. Tolerant mode counts the tear."""
+    path = str(tmp_path / "tornhdr.tfrecord")
+    records = [b"alpha" * 10]
+    write_tfrecords(path, records)
+    with open(path, "ab") as f:
+        f.write(b"\x07\x00\x00")  # 3 bytes of a next record's header
+    assert list(read_tfrecords(path)) == records          # strict: EOF
+    stats = _fresh_stats()
+    assert list(read_tfrecords(path, max_corrupt=5, stats=stats)) == records
+    assert stats.snapshot()["by_reason"] == {"truncated header": 1}
+
+
+def test_tfrecord_same_bad_record_across_epochs_costs_budget_once(tmp_path):
+    """The input pipeline re-opens every shard each epoch: ONE unchanging
+    bit-rotted record must consume the max_corrupt budget once, not once
+    per pass — otherwise a multi-day run with a single bad record dies
+    after max_corrupt epochs."""
+    path = str(tmp_path / "rot.tfrecord")
+    records = [b"alpha" * 10, b"bravo" * 10]
+    write_tfrecords(path, records)
+    raw = bytearray(open(path, "rb").read())
+    raw[12 + 5] ^= 0xFF  # flip a byte inside record 0's payload
+    open(path, "wb").write(bytes(raw))
+    stats = _fresh_stats()
+    for _epoch in range(5):  # 5 epochs >> max_corrupt=2
+        out = list(read_tfrecords(path, verify_crc=True, max_corrupt=2,
+                                  stats=stats))
+        assert out == [records[1]]
+    snap = stats.snapshot()
+    assert snap["count"] == 1       # one distinct site, ever
+    assert snap["repeats"] == 4     # later passes logged, not charged
+
+
+def test_corrupt_records_hook_exports_event_rows(tmp_path):
+    from distributed_resnet_tensorflow_tpu.data import tfrecord
+    from distributed_resnet_tensorflow_tpu.train.hooks import (
+        CorruptRecordsHook)
+    from distributed_resnet_tensorflow_tpu.utils.metrics import (
+        MetricsWriter, read_metrics)
+    tfrecord.corrupt_records.reset()
+    writer = MetricsWriter(str(tmp_path), enable_tensorboard=False)
+    hook = CorruptRecordsHook(writer, every_steps=1)
+    hook(1, None, {})  # nothing corrupt yet: no row
+    tfrecord.corrupt_records.record("/data/train-007", "corrupt data crc")
+    hook(2, None, {})
+    hook(3, None, {})  # count unchanged: no duplicate row
+    writer.close()
+    rows = [r for r in read_metrics(str(tmp_path))
+            if r.get("event") == "corrupt_record"]
+    assert len(rows) == 1
+    assert rows[0]["count"] == 1 and rows[0]["step"] == 2
+    assert rows[0]["recent"][0]["file"] == "train-007"
+    tfrecord.corrupt_records.reset()
+
+
 def test_example_roundtrip():
     ex = build_example({
         "image/encoded": [b"\xff\xd8jpegdata"],

@@ -76,6 +76,123 @@ def test_retry_giveup_short_circuits_permanent_errors():
     assert len(calls) == 1  # permanent: no retries burned
 
 
+def test_retry_backoff_schedule_exponential_and_capped():
+    delays = []
+
+    def always_down():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(always_down, retries=4, base_delay=0.1, max_delay=0.4,
+                   jitter=0.0, sleep=delays.append)
+    # base * 2^attempt, capped at max_delay; no sleep after the last try
+    assert delays == pytest.approx([0.1, 0.2, 0.4, 0.4])
+
+
+def test_retry_jitter_stays_within_fraction():
+    delays = []
+
+    def always_down():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(always_down, retries=10, base_delay=1.0, max_delay=1.0,
+                   jitter=0.5, sleep=delays.append)
+    assert len(delays) == 10
+    assert all(0.5 <= d <= 1.5 for d in delays)  # ±50% around the cap
+
+
+def test_retry_unlisted_exception_passes_through_immediately():
+    calls = []
+
+    def typeerror():
+        calls.append(1)
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError, match="not retryable"):
+        retry_call(typeerror, retries=5, base_delay=0.0,
+                   retry_on=(OSError,), sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_retry_passes_args_kwargs_and_returns_value():
+    def add(a, b, scale=1):
+        return (a + b) * scale
+
+    assert retry_call(add, 2, 3, scale=10, retries=0) == 50
+    with pytest.raises(ValueError):
+        retry_call(add, retries=-1)
+
+
+# ---------------------------------------------------------------------------
+# collective_should_stop throttling (the multi-host stop agreement)
+# ---------------------------------------------------------------------------
+
+class _FakeAllgather:
+    """Stand-in for multihost_utils.process_allgather: records each call's
+    local flag, returns a canned cross-process OR."""
+
+    def __init__(self, remote_flag=False):
+        self.calls = []
+        self.remote_flag = remote_flag
+
+    def __call__(self, arr):
+        local = bool(np.asarray(arr)[0])
+        self.calls.append(local)
+        return np.asarray([local or self.remote_flag])
+
+
+@pytest.fixture()
+def fake_allgather(monkeypatch):
+    from jax.experimental import multihost_utils
+    fake = _FakeAllgather()
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake)
+    return fake
+
+
+def test_collective_stop_throttles_the_host_collective(fake_allgather):
+    from distributed_resnet_tensorflow_tpu.resilience.preemption import (
+        collective_should_stop)
+    listener = PreemptionListener(signals=())
+    stop = collective_should_stop(listener, sync_every=8)
+    assert not any(stop() for _ in range(7))
+    assert len(fake_allgather.calls) == 0   # between sync points: local only
+    assert stop() is False                  # 8th poll pays the collective
+    assert len(fake_allgather.calls) == 1
+    # a LOCAL stop request must not flip the answer between sync points —
+    # stopping unilaterally is the deadlock this function exists to prevent
+    listener.request_stop("test")
+    assert not any(stop() for _ in range(7))
+    assert len(fake_allgather.calls) == 1
+    assert stop() is True                   # next sync point agrees
+    assert len(fake_allgather.calls) == 2
+    assert fake_allgather.calls[-1] is True  # our flag was in the gather
+
+
+def test_collective_stop_sticky_after_agreement(fake_allgather):
+    from distributed_resnet_tensorflow_tpu.resilience.preemption import (
+        collective_should_stop)
+    listener = PreemptionListener(signals=())
+    listener.request_stop("test")
+    stop = collective_should_stop(listener, sync_every=2)
+    assert stop() is False and stop() is True
+    n = len(fake_allgather.calls)
+    # once agreed, no further collectives: the loop is exiting
+    assert stop() is True and stop() is True
+    assert len(fake_allgather.calls) == n
+
+
+def test_collective_stop_mirrors_peer_preemption(fake_allgather):
+    from distributed_resnet_tensorflow_tpu.resilience.preemption import (
+        collective_should_stop)
+    fake_allgather.remote_flag = True       # some OTHER process was signaled
+    listener = PreemptionListener(signals=())
+    stop = collective_should_stop(listener, sync_every=1)
+    assert stop() is True
+    assert listener.preempted()
+    assert listener.reason() == "peer preempted"
+
+
 # ---------------------------------------------------------------------------
 # preemption.py
 # ---------------------------------------------------------------------------
@@ -366,6 +483,120 @@ def test_evaluator_skips_damaged_checkpoint(tmp_path):
     assert ev.last_step == 2    # ...but the damaged step was consumed/skipped
 
 
+def test_evaluator_exits_nonzero_after_consecutive_failures(tmp_path):
+    """eval.max_consecutive_failures: a checkpoint stream where EVERY step
+    is damaged must end the evaluator with an error, not an infinite
+    skip-and-poll loop (the single-skip tolerance above stays)."""
+    from distributed_resnet_tensorflow_tpu.evaluator import Evaluator
+    cfg = _tiny_cfg(tmp_path)
+    cfg.eval.eval_batch_count = 1
+    cfg.eval.max_consecutive_failures = 2
+    tr = Trainer(cfg)
+    tr.init_state()
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False)
+    state, _ = tr.train(learnable_synthetic_iterator(16, 8, 4), num_steps=2)
+    ev = Evaluator(cfg, data_iter=learnable_synthetic_iterator(16, 8, 4))
+    # the poller only surfaces the NEWEST checkpoint, so a broken stream is
+    # one damaged step per poll: first poll skips (1/2), second must raise
+    mngr.save(1, state)
+    faultinject.corrupt_checkpoint(cfg.checkpoint.directory, step=1,
+                                   mode="flip")
+    assert ev.run(timeout_secs=0.0) == {}
+    assert ev.consecutive_failures == 1
+    mngr.save(2, state)
+    faultinject.corrupt_checkpoint(cfg.checkpoint.directory, step=2,
+                                   mode="flip")
+    with pytest.raises(RuntimeError, match="consecutive"):
+        ev.run(timeout_secs=0.0)
+    mngr.close()
+
+
+def test_evaluator_failure_count_resets_on_success(tmp_path):
+    from distributed_resnet_tensorflow_tpu.evaluator import Evaluator
+    cfg = _tiny_cfg(tmp_path)
+    cfg.eval.eval_batch_count = 1
+    cfg.eval.max_consecutive_failures = 2
+    tr = Trainer(cfg)
+    tr.init_state()
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False)
+    state, _ = tr.train(learnable_synthetic_iterator(16, 8, 4), num_steps=2)
+    ev = Evaluator(cfg, data_iter=learnable_synthetic_iterator(16, 8, 4))
+    # damaged, good, damaged: a success between failures must reset the
+    # bound, so the second damaged step is 1/2 again — never a raise
+    for s, damage in ((1, True), (2, False), (3, True)):
+        mngr.save(s, state)
+        if damage:
+            faultinject.corrupt_checkpoint(cfg.checkpoint.directory, step=s,
+                                           mode="flip")
+        out = ev.run(timeout_secs=0.0)
+        if not damage:
+            assert out and "precision" in out
+    mngr.close()
+    assert ev.last_step == 3
+    assert ev.consecutive_failures == 1
+
+
+# ---------------------------------------------------------------------------
+# watchdog fault cases (freeze / slow) — wrapper behavior; the detection
+# logic itself is unit-tested in tests/test_watchdog.py
+# ---------------------------------------------------------------------------
+
+def test_inject_freeze_blocks_at_batch(monkeypatch):
+    naps = []
+    monkeypatch.setattr(faultinject.time, "sleep",
+                        lambda s: naps.append(s))
+    batches = [{"x": i} for i in range(4)]
+    out = list(faultinject.inject_freeze(iter(batches), at_batch=3,
+                                         freeze_secs=123.0))
+    assert out == batches  # batches still flow once the nap ends (tests)
+    assert naps == [123.0]
+
+
+def test_inject_slow_delays_every_batch(monkeypatch):
+    naps = []
+    monkeypatch.setattr(faultinject.time, "sleep",
+                        lambda s: naps.append(s))
+    batches = [{"x": i} for i in range(3)]
+    assert list(faultinject.inject_slow(iter(batches), 0.25)) == batches
+    assert naps == [0.25, 0.25, 0.25]
+
+
+def test_env_fault_scoping_targets_one_process(monkeypatch):
+    """DRT_FAULT_FREEZE_AT_BATCH="1:5" must arm only on process 1 — the
+    launcher hands every child the same environment."""
+    naps = []
+    monkeypatch.setattr(faultinject.time, "sleep",
+                        lambda s: naps.append(s))
+    monkeypatch.setattr(faultinject, "_freeze_armed", False)
+    import jax
+    batches = [{"x": i} for i in range(6)]
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    it = faultinject.maybe_wrap_from_env(
+        iter(batches), env={faultinject.FREEZE_ENV_VAR: "1:5"})
+    assert list(it) == batches and naps == []  # not our process: untouched
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    it = faultinject.maybe_wrap_from_env(
+        iter(batches), env={faultinject.FREEZE_ENV_VAR: "1:5"})
+    assert list(it) == batches
+    assert len(naps) == 1  # froze once, before batch 5
+    # a rebuilt stream (NaN-sentinel rollback) must NOT re-freeze: one
+    # injected wedge would otherwise recur at batch 5 of every replay
+    it = faultinject.maybe_wrap_from_env(
+        iter(batches), env={faultinject.FREEZE_ENV_VAR: "1:5"})
+    assert list(it) == batches and len(naps) == 1
+
+
+def test_env_slow_fault_unscoped_applies_everywhere(monkeypatch):
+    naps = []
+    monkeypatch.setattr(faultinject.time, "sleep",
+                        lambda s: naps.append(s))
+    batches = [{"x": i} for i in range(3)]
+    it = faultinject.maybe_wrap_from_env(
+        iter(batches), env={faultinject.SLOW_ENV_VAR: "0.1"})
+    assert list(it) == batches
+    assert naps == [0.1, 0.1, 0.1]
+
+
 def test_env_nan_injection_hook(monkeypatch):
     batches = [{"images": np.ones((2, 2), np.float32),
                 "labels": np.zeros((2,), np.int32)} for _ in range(3)]
@@ -379,6 +610,233 @@ def test_env_nan_injection_hook(monkeypatch):
     # second wrap in the same process stays clean (sentinel retry contract)
     wrapped2 = faultinject.maybe_wrap_from_env(iter(batches))
     assert all(np.isfinite(next(wrapped2)["images"]).all() for _ in range(3))
+
+
+# ---------------------------------------------------------------------------
+# launch.py supervisor policy (fast, fake children)
+# ---------------------------------------------------------------------------
+
+class _FakeChild:
+    """Popen stand-in: exits with ``code`` once ``after_secs`` elapse (never,
+    when None); dies to any signal the supervisor sends."""
+
+    def __init__(self, code=None, after_secs=0.0):
+        self._code = code
+        self._deadline = time.monotonic() + after_secs
+        self.returncode = None
+        self.signals = []
+
+    def poll(self):
+        if self.returncode is None and self._code is not None and \
+                time.monotonic() >= self._deadline:
+            self.returncode = self._code
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        self.returncode = -sig
+
+    def kill(self):
+        self.send_signal(signal.SIGKILL)
+
+    def wait(self, timeout=None):
+        if self.poll() is None:
+            raise subprocess.TimeoutExpired("fake", timeout)
+        return self.returncode
+
+
+def _supervise(monkeypatch, children, **kw):
+    from distributed_resnet_tensorflow_tpu import launch
+    monkeypatch.setattr(launch, "_spawn",
+                        lambda *a, **k: list(children))
+    return launch.launch_local(len(children), [], poll_secs=0.01, **kw)
+
+
+def test_supervisor_clean_first_exit_spares_slow_sibling(monkeypatch):
+    """A slower sibling after a CLEAN exit is a healthy run finishing at
+    different speeds (final checkpoint drain) — it must not be torn down
+    inside child_grace_secs, and the run must report success."""
+    fast = _FakeChild(code=0)
+    slow = _FakeChild(code=0, after_secs=0.5)
+    rc = _supervise(monkeypatch, [fast, slow], child_grace_secs=0.1)
+    assert rc == 0
+    assert slow.signals == []     # outlived 5x the bad-exit grace unharmed
+
+
+def test_supervisor_bad_first_exit_tears_down_and_reports_failure(monkeypatch):
+    """A NONZERO exit arms the short countdown: the wedged sibling is
+    SIGTERMed after child_grace_secs and the child's real failure code
+    wins the aggregation (never masked as resumable)."""
+    dead = _FakeChild(code=1)
+    wedged = _FakeChild()         # never exits on its own
+    rc = _supervise(monkeypatch, [dead, wedged], child_grace_secs=0.1)
+    assert rc == 1
+    assert signal.SIGTERM in wedged.signals
+
+
+def test_supervisor_resumable_first_exit_spares_draining_sibling(monkeypatch):
+    """Exit 75 is a deliberate resumable departure (fleet-wide preemption):
+    a sibling still draining its preemption checkpoint must not be torn
+    down inside child_grace_secs — that would tear the very save the
+    grace exists to protect."""
+    fast = _FakeChild(code=RESUMABLE_EXIT_CODE)
+    slow = _FakeChild(code=RESUMABLE_EXIT_CODE, after_secs=0.5)
+    rc = _supervise(monkeypatch, [fast, slow], child_grace_secs=0.1)
+    assert rc == RESUMABLE_EXIT_CODE
+    assert slow.signals == []
+
+
+def test_aggregate_rc_forced_childs_own_failure_not_masked():
+    """A torn-down child that still exits with its OWN positive non-75
+    code crashed for real — it must win the aggregation, or a
+    deterministically-broken job requeues until MAX_REQUEUES."""
+    from distributed_resnet_tensorflow_tpu.launch import _aggregate_rc
+    assert _aggregate_rc([1, 2], forced={1}) == 1    # first real failure
+    assert _aggregate_rc([75, 1], forced={1}) == 1   # not masked as 75
+    assert _aggregate_rc([0, -15], forced={1}) == RESUMABLE_EXIT_CODE
+    assert _aggregate_rc([0, 75], forced={1}) == RESUMABLE_EXIT_CODE
+
+
+def test_supervisor_signal_death_is_resumable(monkeypatch):
+    """A child killed by a signal (host loss / OOM shape) arms teardown and
+    aggregates to 75: requeue-and-resume, not failure."""
+    killed = _FakeChild(code=-signal.SIGKILL)
+    wedged = _FakeChild()
+    rc = _supervise(monkeypatch, [killed, wedged], child_grace_secs=0.1)
+    assert rc == RESUMABLE_EXIT_CODE
+    assert signal.SIGTERM in wedged.signals
+
+
+# ---------------------------------------------------------------------------
+# watchdog end-to-end: real 2-process SPMD worlds under launch.py
+# ---------------------------------------------------------------------------
+
+def _watchdog_launch_args(tmp_path, train_steps, *extra):
+    return [
+        "--preset", "smoke",
+        "--set", "model.name=logistic",
+        "--set", "model.input_size=192",
+        "--set", "model.num_classes=10",
+        "--set", "data.image_size=8",
+        "--set", "train.batch_size=16",
+        "--set", f"train.train_steps={train_steps}",
+        "--set", "train.log_every_steps=1000",
+        "--set", f"log_root={tmp_path}",
+        "--set", "checkpoint.save_every_steps=0",
+        "--set", "checkpoint.save_every_secs=0",
+        "--set", "resilience.watchdog.enabled=on",
+        "--set", "resilience.watchdog.interval_secs=0.2",
+        "--set", "resilience.watchdog.peer_timeout_secs=3",
+        "--set", "resilience.watchdog.grace_secs=1",
+        "--set", "resilience.watchdog.min_step_timeout_secs=120",
+        "--set", "resilience.watchdog.straggler_window_secs=1",
+        *extra,
+    ]
+
+
+def _metric_events(tmp_path, sub="train"):
+    path = os.path.join(str(tmp_path), sub, "metrics.jsonl")
+    try:
+        with open(path) as f:
+            return [json.loads(l) for l in f if l.strip()]
+    except FileNotFoundError:
+        return []
+
+
+@pytest.mark.heavy
+def test_watchdog_kill_and_detect_survivor_exits_resumable(tmp_path):
+    """THE acceptance scenario: SIGKILL one of two launch.py workers
+    mid-training. Without the watchdog the survivor blocks in the next
+    collective until the allocation's wall clock; with it, the survivor
+    must exit 75 within the configured detection deadline, the supervisor
+    must reap everything, and the chief's metrics must record the peer
+    loss."""
+    import socket
+    import threading
+
+    from distributed_resnet_tensorflow_tpu.launch import launch_local
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    procs = []
+    result = {}
+
+    def run():
+        result["rc"] = launch_local(
+            2, _watchdog_launch_args(tmp_path, 1_000_000),
+            devices_per_process=1, port=port, procs_out=procs)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # wait for REAL training progress on both processes (beats flowing)
+    hb_dir = os.path.join(str(tmp_path), "heartbeats")
+    deadline = time.time() + 300
+    started = False
+    while time.time() < deadline:
+        beats = []
+        for pid in (0, 1):
+            try:
+                with open(os.path.join(hb_dir, f"proc{pid}.json")) as f:
+                    beats.append(json.load(f))
+            except (OSError, ValueError):
+                break
+        if len(beats) == 2 and all(b["step"] >= 3 for b in beats):
+            started = True
+            break
+        if result.get("rc") is not None:
+            raise AssertionError(
+                f"launcher exited rc={result['rc']} before the kill")
+        time.sleep(0.1)
+    assert started, "2-process training never started beating"
+
+    victim = procs[1]          # the NON-chief worker (chief keeps metrics)
+    victim.send_signal(signal.SIGKILL)
+    killed_at = time.monotonic()
+    # peer_timeout(3) + grace(1) + collective/teardown slack — well under
+    # the launcher's 30s sibling grace, so the SURVIVOR's own watchdog
+    # (not the supervisor's SIGTERM) must be what ends it
+    t.join(timeout=60)
+    assert not t.is_alive(), "launcher still waiting: survivor hung"
+    detect_secs = time.monotonic() - killed_at
+    assert result["rc"] == RESUMABLE_EXIT_CODE, result
+    # the supervisor reaped both children
+    assert all(p.poll() is not None for p in procs)
+    assert detect_secs < 45, f"teardown took {detect_secs:.0f}s"
+    # chief (the survivor) recorded the detection before exiting
+    events = {r.get("event") for r in _metric_events(tmp_path)}
+    assert "peer_lost" in events, sorted(e for e in events if e)
+    assert "watchdog_exit" in events
+
+
+@pytest.mark.heavy
+def test_watchdog_normal_run_emits_heartbeat_and_straggler_rows(tmp_path):
+    """A healthy 2-process run with the watchdog on: completes cleanly
+    (no spurious teardown) AND leaves heartbeat + straggler accounting
+    rows in the chief's metrics.jsonl."""
+    import socket
+
+    from distributed_resnet_tensorflow_tpu.launch import launch_local
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    rc = launch_local(
+        2,
+        # 600 steps ≈ several seconds of steady-state beating, so the 1s
+        # accounting windows fill and export on any host speed
+        _watchdog_launch_args(tmp_path, 600),
+        devices_per_process=1, port=port)
+    assert rc == 0
+    rows = _metric_events(tmp_path)
+    events = [r for r in rows if "event" in r]
+    kinds = {r["event"] for r in events}
+    assert "heartbeat" in kinds, sorted(kinds)
+    assert "straggler" in kinds, sorted(kinds)
+    hb = [r for r in events if r["event"] == "heartbeat"][-1]
+    assert set(hb["hosts"]) == {"0", "1"}
+    strag = [r for r in events if r["event"] == "straggler"][-1]
+    assert set(strag["rates"]) <= {"0", "1"}
+    # and no teardown events on a healthy run
+    assert not kinds & {"peer_lost", "hang", "watchdog_exit", "peer_failed"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,571 @@
+"""Watchdog + heartbeat unit suite (resilience/watchdog.py,
+resilience/heartbeat.py): every detection/escalation path driven with a
+fake transport, fake clocks and a fake exit — no subprocesses, no sleeps.
+The real 2-process kill-and-detect coverage lives in tests/test_resilience.py
+(@heavy); scripts/chaos_smoke.sh --fast runs this file's set."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from distributed_resnet_tensorflow_tpu.resilience.heartbeat import (
+    Beat, BeatTransport, FileBeatTransport, HeartbeatPublisher,
+    PHASE_DONE, PHASE_FAILED)
+from distributed_resnet_tensorflow_tpu.resilience.watchdog import (
+    FAILURE_EXIT_CODE, Watchdog, watchdog_enabled)
+from distributed_resnet_tensorflow_tpu.resilience.preemption import (
+    PreemptionListener, RESUMABLE_EXIT_CODE)
+from distributed_resnet_tensorflow_tpu.utils.config import WatchdogConfig
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class MemoryTransport(BeatTransport):
+    def __init__(self):
+        self.beats = {}
+        self.published = []
+
+    def publish(self, beat: Beat) -> None:
+        self.beats[beat.process_id] = beat
+        self.published.append(beat)
+
+    def peers(self):
+        return dict(self.beats)
+
+
+class FakeWriter:
+    def __init__(self):
+        self.events = []
+
+    def write_event(self, event, payload):
+        self.events.append({"event": event, **payload})
+
+    def flush(self):
+        pass
+
+    def of(self, kind):
+        return [e for e in self.events if e["event"] == kind]
+
+
+class ExitCalled(Exception):
+    def __init__(self, code):
+        super().__init__(f"exit({code})")
+        self.code = code
+
+
+def _beat(pid, step=0, phase="train", wall_time=1000.0, progress=None):
+    return Beat(process_id=pid, pid=100 + pid, host=f"h{pid}", seq=1,
+                step=step, progress=step if progress is None else progress,
+                phase=phase, wall_time=wall_time)
+
+
+def make_watchdog(num_processes=2, process_id=0, writer=None,
+                  request_stop=None, **cfg_kw):
+    cfg = WatchdogConfig(interval_secs=1.0, peer_timeout_secs=10.0,
+                         step_timeout_scale=10.0, min_step_timeout_secs=30.0,
+                         grace_secs=5.0, straggler_window_secs=60.0,
+                         straggler_ratio=1.5)
+    for k, v in cfg_kw.items():
+        setattr(cfg, k, v)
+    clock = FakeClock()
+    transport = MemoryTransport()
+    publisher = HeartbeatPublisher(transport, process_id, clock=clock,
+                                   wall_clock=clock)
+    exits = []
+
+    def exit_fn(code):
+        exits.append(code)
+        raise ExitCalled(code)
+
+    wd = Watchdog(transport, publisher, process_id, num_processes, cfg,
+                  writer=writer, request_stop=request_stop, clock=clock,
+                  wall_clock=clock, exit_fn=exit_fn)
+    return wd, transport, publisher, clock, exits
+
+
+# ---------------------------------------------------------------------------
+# enable resolution
+# ---------------------------------------------------------------------------
+
+def test_watchdog_enabled_tristate():
+    cfg = WatchdogConfig()
+    assert not watchdog_enabled(cfg, 1)   # auto: nothing to watch solo
+    assert watchdog_enabled(cfg, 2)
+    cfg.enabled = "on"
+    assert watchdog_enabled(cfg, 1)
+    cfg.enabled = "off"
+    assert not watchdog_enabled(cfg, 8)
+    cfg.enabled = "sometimes"
+    with pytest.raises(ValueError):
+        watchdog_enabled(cfg, 2)
+
+
+# ---------------------------------------------------------------------------
+# peer loss
+# ---------------------------------------------------------------------------
+
+def test_peer_loss_detected_and_hard_exits_75_after_grace():
+    stops = []
+    wd, tr, pub, clock, exits = make_watchdog(
+        request_stop=lambda reason: stops.append(reason))
+    pub.update(step=1, phase="train")
+    tr.publish(_beat(1, step=1, wall_time=clock.t))
+    wd._tick(clock.t)                     # fresh: no verdict
+    assert wd.fired() is None
+    clock.advance(11.0)                   # beat now 11s old > 10s timeout
+    pub.tick()                            # we ourselves stay live
+    wd._tick(clock.t)
+    assert wd.fired() == "peer_lost"
+    assert stops == ["peer_lost"]         # graceful stop requested first
+    clock.advance(1.0)
+    wd._tick(clock.t)                     # inside grace: no exit yet
+    assert not exits
+    clock.advance(5.0)
+    pub.tick()                            # main thread alive is irrelevant:
+    with pytest.raises(ExitCalled):       # the peer is still gone
+        wd._tick(clock.t)
+    assert exits == [RESUMABLE_EXIT_CODE]
+
+
+def test_peer_beats_resuming_cancels_teardown():
+    wd, tr, pub, clock, exits = make_watchdog()
+    pub.update(step=1, phase="train")
+    tr.publish(_beat(1, step=1, wall_time=clock.t))
+    clock.advance(11.0)
+    pub.tick()
+    wd._tick(clock.t)
+    assert wd.fired() == "peer_lost"
+    # the peer was only GC-paused: beats resume within the grace window
+    tr.publish(_beat(1, step=2, wall_time=clock.t))
+    clock.advance(6.0)
+    tr.publish(_beat(1, step=3, wall_time=clock.t))
+    pub.tick()
+    wd._tick(clock.t)
+    assert not exits and wd.fired() is None
+
+
+def test_departed_peers_are_not_flagged():
+    wd, tr, pub, clock, exits = make_watchdog(num_processes=3)
+    pub.update(step=5, phase="train")
+    tr.publish(_beat(1, step=5, phase=PHASE_DONE, wall_time=clock.t))
+    tr.publish(_beat(2, step=5, phase="preempted", wall_time=clock.t))
+    clock.advance(100.0)
+    pub.tick()
+    wd._tick(clock.t)
+    assert wd.fired() is None and not exits
+
+
+def test_never_seen_peer_is_not_flagged():
+    # bootstrap races belong to the distributed-init retry, not the watchdog
+    wd, tr, pub, clock, exits = make_watchdog(num_processes=2)
+    pub.update(step=1, phase="train")
+    clock.advance(100.0)
+    pub.tick()
+    wd._tick(clock.t)
+    assert wd.fired() is None
+
+
+def test_peer_failed_beat_escalates_with_failure_code():
+    wd, tr, pub, clock, exits = make_watchdog(grace_secs=0.0)
+    pub.update(step=1, phase="train")
+    tr.publish(_beat(1, step=1, phase=PHASE_FAILED, wall_time=clock.t))
+    wd._tick(clock.t)
+    assert wd.fired() == "peer_failed"
+    clock.advance(0.1)
+    with pytest.raises(ExitCalled):
+        wd._tick(clock.t)
+    assert exits == [FAILURE_EXIT_CODE]   # real failure must NOT requeue
+
+
+def test_failed_beat_during_grace_upgrades_peer_lost_to_failure_code():
+    # peer 1 goes stale -> peer_lost (75) fires; its final failed beat
+    # lands DURING the grace window (slow FS) -> the exit must carry the
+    # failure code, not requeue-mask the real error as preemption
+    wd, tr, pub, clock, exits = make_watchdog()
+    pub.update(step=1, phase="train")
+    tr.publish(_beat(1, step=1, wall_time=clock.t))
+    clock.advance(11.0)
+    pub.tick()
+    wd._tick(clock.t)
+    assert wd.fired() == "peer_lost"
+    tr.publish(_beat(1, step=1, phase=PHASE_FAILED, wall_time=clock.t))
+    clock.advance(6.0)
+    pub.tick()
+    with pytest.raises(ExitCalled):
+        wd._tick(clock.t)
+    assert exits == [FAILURE_EXIT_CODE]
+
+
+def test_peer_failed_outranks_another_peers_staleness():
+    # peer 1 merely stale, peer 2 published a fatal beat: the verdict must
+    # be peer_failed regardless of scan order
+    wd, tr, pub, clock, exits = make_watchdog(num_processes=3,
+                                              grace_secs=0.0)
+    pub.update(step=1, phase="train")
+    tr.publish(_beat(1, step=1, wall_time=clock.t - 11.0))  # stale
+    tr.publish(_beat(2, step=1, phase=PHASE_FAILED, wall_time=clock.t))
+    wd._tick(clock.t)
+    assert wd.fired() == "peer_failed"
+    clock.advance(0.1)
+    with pytest.raises(ExitCalled):
+        wd._tick(clock.t)
+    assert exits == [FAILURE_EXIT_CODE]
+
+
+# ---------------------------------------------------------------------------
+# hang detection + rolling deadline
+# ---------------------------------------------------------------------------
+
+def test_hang_detected_when_progress_stalls_past_min_deadline():
+    wd, tr, pub, clock, exits = make_watchdog(num_processes=1,
+                                              min_step_timeout_secs=30.0)
+    pub.update(step=1, phase="train")
+    clock.advance(29.0)
+    wd._tick(clock.t)
+    assert wd.fired() is None             # under the deadline
+    clock.advance(2.0)
+    wd._tick(clock.t)
+    assert wd.fired() == "hang"
+
+
+def test_hang_deadline_scales_with_rolling_step_time():
+    wd, tr, pub, clock, exits = make_watchdog(
+        num_processes=1, min_step_timeout_secs=5.0, step_timeout_scale=10.0)
+    # steps at ~2s each: the EWMA-derived deadline (10 x 2s) must dominate
+    # the 5s floor. First delta (compile-laden) is discarded by design.
+    for step in range(1, 6):
+        pub.update(step=step, phase="train")
+        clock.advance(2.0)
+    assert pub.snapshot()["ewma_step_secs"] == pytest.approx(2.0)
+    clock.advance(8.0)                    # 10s stalled: > floor, < 10x2s
+    wd._tick(clock.t)
+    assert wd.fired() is None
+    clock.advance(11.0)                   # 21s > 20s rolling deadline
+    wd._tick(clock.t)
+    assert wd.fired() == "hang"
+
+
+def test_hang_deadline_scales_with_fused_loop_stride():
+    """With train.steps_per_loop=K the publisher only sees one update per
+    K steps: the deadline must be per UPDATE (est x stride x scale), or a
+    healthy 64-step scan outlives a 10x-one-step deadline mid-loop."""
+    wd, tr, pub, clock, exits = make_watchdog(
+        num_processes=1, min_step_timeout_secs=5.0, step_timeout_scale=10.0)
+    for i in range(1, 6):                 # updates every 64 steps, 2s/step
+        pub.update(step=64 * i, phase="train")
+        clock.advance(128.0)
+    snap = pub.snapshot()
+    assert snap["ewma_step_secs"] == pytest.approx(2.0)
+    assert snap["step_stride"] == 64
+    clock.advance(200.0)                  # mid-scan: way past 10x2s=20s
+    wd._tick(clock.t)
+    assert wd.fired() is None             # healthy loop, not a hang
+    clock.advance(1200.0)                 # 1400s > 10 x 2s x 64 = 1280s
+    wd._tick(clock.t)
+    assert wd.fired() == "hang"
+
+
+def test_peer_loss_exit_deferred_while_final_save_in_flight():
+    """Grace expiry must not os._exit mid-save: the coordinated stop's
+    whole point is committing that final checkpoint. Bounded — a save
+    wedged past the deferral cap still dies."""
+    wd, tr, pub, clock, exits = make_watchdog(
+        grace_secs=5.0, min_step_timeout_secs=30.0)
+    pub.update(step=1, phase="train")
+    tr.publish(_beat(1, step=1, wall_time=clock.t))
+    wd._tick(clock.t)
+    clock.advance(11.0)
+    pub.tick()
+    wd._tick(clock.t)
+    assert wd.fired() == "peer_lost"
+    pub.set_phase("save")                 # stop honored: final save running
+    clock.advance(6.0)                    # grace expired, save in flight
+    wd._tick(clock.t)
+    assert not exits                      # deferred, not torn mid-save
+    clock.advance(26.0)                   # 32s > cap max(5, 30): wedged
+    with pytest.raises(ExitCalled):
+        wd._tick(clock.t)
+    assert exits == [RESUMABLE_EXIT_CODE]
+
+
+def test_no_hang_detection_outside_monitored_phases():
+    wd, tr, pub, clock, exits = make_watchdog(num_processes=1,
+                                              min_step_timeout_secs=5.0)
+    pub.set_phase("init")                 # compile/restore take arbitrarily long
+    clock.advance(1000.0)
+    wd._tick(clock.t)
+    assert wd.fired() is None
+    pub.set_phase("save")
+    clock.advance(1000.0)
+    wd._tick(clock.t)
+    assert wd.fired() is None
+
+
+def test_hang_clearing_in_grace_cancels_exit():
+    wd, tr, pub, clock, exits = make_watchdog(num_processes=1,
+                                              min_step_timeout_secs=5.0,
+                                              grace_secs=10.0)
+    pub.update(step=1, phase="train")
+    clock.advance(6.0)
+    wd._tick(clock.t)
+    assert wd.fired() == "hang"
+    pub.update(step=2)                    # the step finally landed
+    clock.advance(11.0)
+    wd._tick(clock.t)
+    assert not exits and wd.fired() is None
+
+
+def test_disarm_suppresses_exit():
+    wd, tr, pub, clock, exits = make_watchdog(num_processes=1,
+                                              min_step_timeout_secs=5.0,
+                                              grace_secs=1.0)
+    pub.update(step=1, phase="train")
+    clock.advance(6.0)
+    wd._tick(clock.t)
+    assert wd.fired() == "hang"
+    wd.disarm()                           # orderly shutdown owns the exit now
+    clock.advance(100.0)
+    wd._tick(clock.t)
+    assert not exits
+
+
+# ---------------------------------------------------------------------------
+# exception-path classification
+# ---------------------------------------------------------------------------
+
+def test_failure_verdict_confirms_stale_peer():
+    wd, tr, pub, clock, exits = make_watchdog()
+    tr.publish(_beat(1, step=3, wall_time=clock.t))
+    wd._tick(clock.t)                     # peer registered while fresh
+    clock.advance(11.0)
+    kind, code, detail = wd.failure_verdict(wait_secs=0.0)
+    assert kind == "peer_lost" and code == RESUMABLE_EXIT_CODE
+    assert "process 1" in detail
+
+
+def test_failure_verdict_none_when_peers_healthy():
+    wd, tr, pub, clock, exits = make_watchdog()
+    tr.publish(_beat(1, step=3, wall_time=clock.t))
+    assert wd.failure_verdict(wait_secs=0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# straggler accounting + heartbeat export
+# ---------------------------------------------------------------------------
+
+def test_straggler_rows_flag_slow_host():
+    writer = FakeWriter()
+    wd, tr, pub, clock, exits = make_watchdog(
+        num_processes=2, writer=writer, straggler_window_secs=10.0,
+        straggler_ratio=1.5, peer_timeout_secs=1e9)
+    # 10 ticks: process 0 runs 10 steps/s, process 1 only 2 steps/s
+    for i in range(12):
+        tr.publish(_beat(0, step=10 * i, wall_time=clock.t))
+        tr.publish(_beat(1, step=2 * i, wall_time=clock.t))
+        wd._tick(clock.t)
+        clock.advance(1.0)
+    rows = writer.of("straggler")
+    assert rows, "straggler accounting rows must appear"
+    last = rows[-1]
+    assert last["flagged"] == [1]
+    assert last["rates"]["1"] < last["rates"]["0"]
+    assert last["lag_steps"]["1"] > 0
+    hb = writer.of("heartbeat")
+    assert hb and set(hb[-1]["hosts"]) == {"0", "1"}
+
+
+def test_straggler_median_is_true_median_on_even_host_count():
+    """2-host world, rates 9.0 vs 5.9: the upper-middle element would be
+    the MAX (9.0/5.9 = 1.53 >= 1.5, spurious flag); the true median 7.45
+    gives 1.26 and must flag nothing."""
+    writer = FakeWriter()
+    wd, tr, pub, clock, exits = make_watchdog(
+        num_processes=2, writer=writer, straggler_window_secs=10.0,
+        straggler_ratio=1.5, peer_timeout_secs=1e9)
+    for i in range(12):
+        tr.publish(_beat(0, step=int(90 * i), wall_time=clock.t))
+        tr.publish(_beat(1, step=int(59 * i), wall_time=clock.t))
+        wd._tick(clock.t)
+        clock.advance(1.0)
+    rows = writer.of("straggler")
+    assert rows and rows[-1]["flagged"] == []
+    assert abs(rows[-1]["median"] - (90 + 59) / 2.0) < 1.0
+
+
+def test_straggler_rows_on_balanced_hosts_flag_nothing():
+    writer = FakeWriter()
+    wd, tr, pub, clock, exits = make_watchdog(
+        num_processes=2, writer=writer, straggler_window_secs=10.0,
+        peer_timeout_secs=1e9)
+    for i in range(12):
+        for pid in (0, 1):
+            tr.publish(_beat(pid, step=5 * i, wall_time=clock.t))
+        wd._tick(clock.t)
+        clock.advance(1.0)
+    rows = writer.of("straggler")
+    assert rows and rows[-1]["flagged"] == []
+
+
+def test_escalation_writes_event_rows():
+    writer = FakeWriter()
+    stops = []
+    wd, tr, pub, clock, exits = make_watchdog(
+        writer=writer, request_stop=stops.append, grace_secs=1.0)
+    pub.update(step=1, phase="train")
+    tr.publish(_beat(1, step=1, wall_time=clock.t))
+    clock.advance(11.0)
+    pub.tick()
+    wd._tick(clock.t)
+    assert [e["event"] for e in writer.events
+            if e["event"] == "peer_lost"] == ["peer_lost"]
+    clock.advance(2.0)
+    pub.tick()
+    with pytest.raises(ExitCalled):
+        wd._tick(clock.t)
+    kinds = [e["event"] for e in writer.events]
+    assert "watchdog_exit" in kinds
+
+
+# ---------------------------------------------------------------------------
+# heartbeat publisher + file transport
+# ---------------------------------------------------------------------------
+
+def test_publisher_thread_beats_while_main_thread_blocked(tmp_path):
+    tr = FileBeatTransport(str(tmp_path), 0)
+    pub = HeartbeatPublisher(tr, 0, interval_secs=0.05)
+    pub.start()
+    try:
+        pub.update(step=3, phase="train")
+        deadline = time.monotonic() + 5.0
+        seq = None
+        while time.monotonic() < deadline:
+            beats = tr.peers()
+            if 0 in beats and beats[0].step == 3 and beats[0].seq >= 3:
+                seq = beats[0].seq
+                break
+            time.sleep(0.02)
+        assert seq is not None, "publisher thread never beat"
+    finally:
+        pub.close()
+    assert tr.peers()[0].phase == PHASE_DONE  # final beat marks departure
+
+
+def test_file_transport_roundtrip_and_junk_tolerance(tmp_path):
+    # epoch-0 clocks: the fixture beats carry wall_time=1000.0, which the
+    # previous-run filter would drop against the real time.time() epoch
+    t0 = FileBeatTransport(str(tmp_path), 0, wall_clock=lambda: 0.0)
+    t1 = FileBeatTransport(str(tmp_path), 1, wall_clock=lambda: 0.0)
+    t0.publish(_beat(0, step=7))
+    t1.publish(_beat(1, step=9))
+    # torn/garbage files must be skipped, not fatal
+    with open(os.path.join(str(tmp_path), "proc2.json"), "w") as f:
+        f.write('{"process_id": 2, "ste')
+    with open(os.path.join(str(tmp_path), "ignore.txt"), "w") as f:
+        f.write("not a beat")
+    peers = t0.peers()
+    assert set(peers) == {0, 1}
+    assert peers[1].step == 9
+
+
+def test_file_transport_clears_own_stale_file(tmp_path):
+    # a relaunch must not inherit last run's (dead-looking) beat
+    FileBeatTransport(str(tmp_path), 0).publish(
+        _beat(0, step=100, wall_time=1.0))
+    t = FileBeatTransport(str(tmp_path), 0)
+    assert 0 not in t.peers()
+
+
+def test_file_transport_final_beat_outranks_straggling_live_beat(tmp_path):
+    """A publisher thread stuck in a shared-FS stall can land a stale
+    phase="train" beat AFTER close() published the final "done" — the
+    sidecar final file must still win, or survivors watch the stale beat
+    age into a spurious peer_lost 75 for a peer that finished cleanly."""
+    t = FileBeatTransport(str(tmp_path), 0, wall_clock=lambda: 0.0)
+    t.publish(_beat(0, step=9, phase="train", wall_time=10.0))
+    t.publish(_beat(0, step=10, phase=PHASE_DONE, wall_time=11.0))
+    # the stuck thread's write completes last, replacing the regular file
+    t.publish(_beat(0, step=9, phase="train", wall_time=10.5))
+    assert t.peers()[0].phase == PHASE_DONE
+
+
+def test_file_transport_ignores_previous_run_peer_beats(tmp_path):
+    # after a requeue the shared dir still holds every OTHER process's
+    # previous-run file; a fast-starting peer must not read one (arbitrarily
+    # old, possibly phase="failed") and fire a spurious teardown before the
+    # slow peer's first beat of THIS run lands
+    FileBeatTransport(str(tmp_path), 1, wall_clock=lambda: 50.0).publish(
+        _beat(1, step=100, wall_time=60.0, phase="failed"))
+    t0 = FileBeatTransport(str(tmp_path), 0, wall_clock=lambda: 100.0)
+    assert 1 not in t0.peers()    # published before our epoch: filtered
+    FileBeatTransport(str(tmp_path), 1, wall_clock=lambda: 110.0).publish(
+        _beat(1, step=3, wall_time=120.0))
+    assert t0.peers()[1].step == 3  # the new run's beat becomes visible
+
+
+def test_ewma_skips_post_interlude_delta():
+    """The first step delta after an eval/save pause spans the whole pause;
+    folding it in (alpha 0.3) would inflate the hang deadline by hours —
+    it must be discarded like the compile-laden first delta."""
+    clock = FakeClock()
+    tr = MemoryTransport()
+    pub = HeartbeatPublisher(tr, 0, clock=clock, wall_clock=clock)
+    for step in (1, 2, 3, 4):
+        pub.update(step=step, phase="train")
+        clock.advance(1.0)
+    ewma = pub.snapshot()["ewma_step_secs"]
+    assert ewma == pytest.approx(1.0)
+    pub.tick(phase="eval")            # 30-minute eval round
+    clock.advance(1800.0)
+    pub.update(step=5, phase="train")  # delta spans the pause: discarded
+    assert pub.snapshot()["ewma_step_secs"] == pytest.approx(ewma)
+    clock.advance(1.0)
+    pub.update(step=6, phase="train")  # steady state resumes folding
+    assert pub.snapshot()["ewma_step_secs"] == pytest.approx(1.0)
+
+
+def test_exit_suppressed_when_disarmed_mid_verdict():
+    """disarm() landing while the daemon is inside the slow verdict
+    re-check must suppress the hard exit — a completed run must never be
+    75'd by its own watchdog."""
+    wd, tr, pub, clock, exits = make_watchdog()
+    wd.disarm()
+    wd.exit_now("peer_lost", RESUMABLE_EXIT_CODE, "stale test peer")
+    assert exits == []
+
+
+def test_publisher_progress_counts_eval_ticks():
+    clock = FakeClock()
+    tr = MemoryTransport()
+    pub = HeartbeatPublisher(tr, 0, clock=clock, wall_clock=clock)
+    pub.update(step=1, phase="train")
+    p0 = pub.snapshot()["progress"]
+    pub.tick(phase="eval")
+    pub.tick()
+    snap = pub.snapshot()
+    assert snap["progress"] == p0 + 2
+    assert snap["phase"] == "eval"
+    assert snap["step"] == 1              # eval must not move the step
+
+
+def test_listener_request_stop_feeds_stop_poll():
+    listener = PreemptionListener(signals=())
+    assert not listener.should_stop()
+    listener.request_stop("peer_lost")
+    assert listener.should_stop()
+    assert listener.reason() == "peer_lost"
