@@ -157,11 +157,12 @@ def bench_cifar():
     import jax.numpy as jnp
     blob = np.random.RandomState(1).randint(
         0, 256, 8 * 10 ** 6, dtype=np.uint8)
-    jax.device_put(blob).block_until_ready()
+    # raw-link probe: measuring device_put itself IS the point here
+    jax.device_put(blob).block_until_ready()  # shardcheck: ok(stray-device-put)
     best_put = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        y = jax.device_put(blob)
+        y = jax.device_put(blob)  # shardcheck: ok(stray-device-put)
         float(jnp.sum(y[:8].astype(jnp.float32)))  # fence via host pull
         best_put = min(best_put, time.perf_counter() - t0)
 
@@ -252,11 +253,12 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     import jax.numpy as jnp
     bytes_per_image = 224 * 224 * 3
     probe = np.zeros((128, 224, 224, 3), np.uint8)
-    jax.device_put(probe).block_until_ready()
+    # raw-link probe: measuring device_put itself IS the point here
+    jax.device_put(probe).block_until_ready()  # shardcheck: ok(stray-device-put)
     best_put = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        y = jax.device_put(probe)
+        y = jax.device_put(probe)  # shardcheck: ok(stray-device-put)
         float(jnp.sum(y[:2, :2, :2].astype(jnp.float32)))  # host-pull fence
         best_put = min(best_put, time.perf_counter() - t0)
     put_mbps = probe.nbytes / 1e6 / best_put

@@ -1,0 +1,21 @@
+"""Shardcheck: static elaboration + project-invariant linting.
+
+The most expensive bug class on a shared cluster is the one that turns a
+20-minute queue wait into a step-1 crash: a ``PartitionSpec`` that does
+not match the mesh, a ``--set`` knob that silently does not exist, or a
+cross-thread dispatch that deadlocks a collective. This package catches
+all three in seconds, on a laptop, with zero data and zero compute:
+
+  * ``elaborate``   — virtual-device mesh + ``jax.eval_shape`` over the
+                      real train/eval steps and the restore contract for
+                      every preset × mesh layout (docs/static_analysis.md);
+  * ``lint``        — AST rules for the invariants this codebase learned
+                      the hard way (one rule per module under ``rules/``);
+  * ``dispatch_sanitizer`` — opt-in runtime guard for the one-thread
+                      multi-device dispatch constraint
+                      (docs/input_pipeline.md threading model).
+
+Surfaced as ``python -m distributed_resnet_tensorflow_tpu.main check``
+and the pre-submit gate ``scripts/analysis_gate.sh``.
+"""
+from .report import Finding, format_findings  # noqa: F401

@@ -1,0 +1,62 @@
+"""The ``check`` subcommand: lint + static elaboration in one gate.
+
+    python -m distributed_resnet_tensorflow_tpu.main check --all-presets
+    python -m distributed_resnet_tensorflow_tpu.main check --preset smoke
+    python -m distributed_resnet_tensorflow_tpu.main check --lint-only
+
+Exit code 0 = clean, 1 = findings (the exit-code contract's real-failure
+code: a red gate must fail the submit). Designed to finish in well under
+a minute on CPU — scripts/analysis_gate.sh runs it pre-submit
+(scripts/submit_tpu_slurm.sh) and pre-merge (scripts/chaos_smoke.sh
+--fast). docs/static_analysis.md is the manual.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Optional, Sequence
+
+
+def main_check(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="main.py check",
+        description="shardcheck: invariant lint + static elaboration")
+    scope = p.add_mutually_exclusive_group()
+    scope.add_argument("--all-presets", action="store_true",
+                       help="elaborate every preset (also the default)")
+    scope.add_argument("--preset", action="append", default=[],
+                       help="elaborate only this preset (repeatable)")
+    depth = p.add_mutually_exclusive_group()
+    depth.add_argument("--lint-only", action="store_true",
+                       help="skip elaboration")
+    depth.add_argument("--elaborate-only", action="store_true",
+                       help="skip the linter")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU mesh size for elaboration (default 8)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="print finding detail (full tracebacks)")
+    ns = p.parse_args(argv)
+
+    findings = []
+    t0 = time.perf_counter()
+    if not ns.elaborate_only:
+        from .lint import run_lint
+        findings += run_lint()
+        print(f"lint: {len(findings)} finding(s) "
+              f"[{time.perf_counter() - t0:.1f}s]")
+    if not ns.lint_only:
+        # the virtual mesh must exist BEFORE the first jax backend use
+        from ..utils.virtual_devices import apply_virtual_cpu
+        apply_virtual_cpu(ns.devices)
+        from .elaborate import run_elaborate
+        t1 = time.perf_counter()
+        presets = ns.preset or None  # None = all
+        efs = run_elaborate(presets, n_devices=ns.devices)
+        print(f"elaborate: {len(efs)} finding(s) "
+              f"[{time.perf_counter() - t1:.1f}s]")
+        findings += efs
+
+    from .report import format_findings
+    print(format_findings(findings, verbose=ns.verbose))
+    print(f"shardcheck total: {time.perf_counter() - t0:.1f}s")
+    return 1 if findings else 0

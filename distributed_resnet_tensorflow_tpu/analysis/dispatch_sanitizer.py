@@ -1,0 +1,140 @@
+"""Runtime dispatch sanitizer: ONE thread launches multi-device programs.
+
+The PR 2 constraint (docs/input_pipeline.md, parallel/sharding.StagedBatch):
+two threads launching multi-device XLA executions interleave their
+per-device enqueue order and can DEADLOCK against a collective-bearing
+step — observed on the CPU backend, and the reason ``StagedBatch.finalize``
+must run on the consumer thread while the staging thread only moves bytes
+(``device_put`` has no cross-device rendezvous and stays safe).
+
+Until now that rule lived in a docs paragraph. This module makes it
+executable: ``install()`` wraps jax's compiled-execution entry point
+(``pxla.ExecuteReplicated.__call__``); the first thread to launch a
+multi-device execution becomes the OWNER, and any later launch from a
+different thread raises :class:`CrossThreadDispatchError` immediately —
+at the offending call site, with both thread names — instead of wedging
+the cluster at the next collective.
+
+Opt-in and NOT free: jit's C++ fastpath dispatches cached executions
+without touching Python, so while the sanitizer is installed the
+fastpath is disabled (``_get_fastpath_data`` returns None) and the jit
+caches are cleared — every dispatch pays the Python-path overhead and
+armed/disarmed transitions recompile. That is the honest price of
+instrumenting every launch; use it in debug/bringup runs, not
+production. Set ``--set analysis.dispatch_sanitizer=true`` (wired in
+main.py), or use ``enabled()`` / ``install()`` directly in tests.
+Single-device executions are never restricted.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_installed = False
+_orig_call = None
+_orig_fastpath = None
+_owner: Optional[tuple] = None  # (thread_ident, thread_name)
+
+
+class CrossThreadDispatchError(RuntimeError):
+    """A second thread launched a multi-device XLA execution."""
+
+
+def _owner_claim_or_raise(n_devices: int, program: str) -> None:
+    global _owner
+    if n_devices <= 1:
+        return
+    me = threading.current_thread()
+    with _lock:
+        if _owner is None:
+            _owner = (me.ident, me.name)
+            return
+        if _owner[0] == me.ident:
+            return
+        owner_name = _owner[1]
+    raise CrossThreadDispatchError(
+        f"multi-device execution {program!r} launched from thread "
+        f"{me.name!r} while thread {owner_name!r} owns multi-device "
+        "dispatch — two dispatching threads interleave per-device enqueue "
+        "order and can deadlock a collective-bearing step "
+        "(docs/input_pipeline.md threading model; StagedBatch.finalize "
+        "belongs on the consumer thread). Move this launch to the owner "
+        "thread, or call analysis.dispatch_sanitizer.reset_owner() at a "
+        "legitimate ownership handoff.")
+
+
+def install() -> None:
+    """Idempotently wrap the compiled-execution entry point (and route
+    every dispatch through it by disabling jit's C++ fastpath)."""
+    global _installed, _orig_call, _orig_fastpath
+    import jax
+    from jax._src import pjit as _pjit
+    from jax._src.interpreters import pxla
+
+    with _lock:
+        if _installed:
+            return
+        _orig_call = pxla.ExecuteReplicated.__call__
+        _orig_fastpath = _pjit._get_fastpath_data
+        orig = _orig_call
+
+        def guarded(self, *args):
+            _owner_claim_or_raise(len(self._local_devices),
+                                  getattr(self, "name", "<unknown>"))
+            return orig(self, *args)
+
+        # patch INSIDE the lock: a concurrent install() must not observe
+        # _installed=True while the original, unguarded entry points are
+        # still in place
+        pxla.ExecuteReplicated.__call__ = guarded
+        # keep dispatch on the Python path while armed: the C++ fastpath
+        # replays cached executions without entering __call__ at all
+        _pjit._get_fastpath_data = lambda *a, **k: None
+        _installed = True
+    # flush fastpath data cached before arming (recompiles on next call)
+    jax.clear_caches()
+
+
+def uninstall() -> None:
+    global _installed, _orig_call, _orig_fastpath, _owner
+    import jax
+    from jax._src import pjit as _pjit
+    from jax._src.interpreters import pxla
+
+    with _lock:
+        if not _installed:
+            return
+        pxla.ExecuteReplicated.__call__ = _orig_call
+        _pjit._get_fastpath_data = _orig_fastpath
+        _installed = False
+        _orig_call = None
+        _orig_fastpath = None
+        _owner = None
+    # drop the fastpath-less cached entries so production dispatch speed
+    # returns (recompiles on next call)
+    jax.clear_caches()
+
+
+def reset_owner() -> None:
+    """Forget the owning thread — for legitimate handoffs (e.g. a runner
+    that finishes its train loop on one thread and evaluates on another).
+    The next multi-device launch claims ownership."""
+    global _owner
+    with _lock:
+        _owner = None
+
+
+def is_installed() -> bool:
+    return _installed
+
+
+@contextlib.contextmanager
+def enabled():
+    """Scoped install/uninstall (tests)."""
+    install()
+    try:
+        yield
+    finally:
+        uninstall()

@@ -1,0 +1,278 @@
+"""Static elaboration: trace every preset × mesh layout abstractly.
+
+For each configuration this module builds a VIRTUAL device mesh
+(``utils/virtual_devices.py`` — the same fake-CPU-mesh trick the test
+suite and ``dryrun_multichip`` use), constructs the real Trainer, and
+pushes shape/dtype-only values through:
+
+  * state construction  (``train/state.abstract_train_state``),
+  * the sharding rules  (every leaf's PartitionSpec validated against its
+    shape and the mesh — the offending PARAM PATH and spec are reported,
+    not a 40-frame XLA traceback),
+  * the train step      (``jax.eval_shape`` of value_and_grad — this is
+    where shard_map in/out-spec errors, rank errors and divisibility
+    errors surface at trace time; the pp×ep MoE ``_SpecError`` of
+    tests/test_pipeline.py was located exactly this way),
+  * the eval step, and
+  * the checkpoint-restore contract (layout stamp + unique leaf paths).
+
+Zero data, zero compute, no compilation: the whole ``--all-presets``
+sweep runs in seconds on CPU — cheap enough to be a pre-submit gate
+(``scripts/analysis_gate.sh``) instead of a 20-minute queue wait that
+ends in a step-1 crash.
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .report import Finding
+
+
+def _findings_from_exc(rule: str, locus: str, phase: str,
+                       exc: Exception) -> Finding:
+    msg = f"{type(exc).__name__}: {exc}"
+    first = msg.splitlines()[0][:300]
+    return Finding(rule, locus, 0, f"{phase}: {first}", detail=msg[:4000])
+
+
+def candidate_layouts(cfg, n_devices: int) -> List[Tuple[str, "object"]]:
+    """(label, MeshConfig) pairs worth elaborating for this config.
+
+    Always the two data-parallel shapes every model family supports; for
+    the transformer family additionally a pipeline and a tensor layout
+    (those axes only have consumers there — Trainer rejects them
+    elsewhere). Layouts that cannot satisfy the model's own divisibility
+    contracts (depth % stages, heads % tensor, local batch % microbatches)
+    are filtered HERE — the elaborator's job is finding bugs in valid
+    configs, not re-reporting documented constraints."""
+    from ..utils.config import MeshConfig
+    out = [("dp", MeshConfig(data=n_devices))]
+    if n_devices % 2 == 0:
+        out.append(("dp_fsdp", MeshConfig(data=n_devices // 2, fsdp=2)))
+    if cfg.model.name == "vit":
+        from ..models.pipeline import resolve_microbatches
+        depth = cfg.model.vit_depth
+        heads = cfg.model.vit_heads
+        hidden = 4 * cfg.model.vit_dim
+        bs = cfg.train.batch_size
+        v = max(1, cfg.model.vit_pipeline_interleave)
+        p = 2
+        m = resolve_microbatches(cfg.model.vit_pipeline_microbatches, p)
+
+        def pp_ok(local_b: int) -> bool:
+            # mirror PipelinedEncoder's OWN contract exactly (depth %
+            # (P*v), local batch % M, and M >= P only under the circular
+            # schedule's wrap) — stricter filtering here would silently
+            # drop layouts that run fine, laxer would re-report the
+            # encoder's documented ValueErrors as gate findings
+            return depth % (p * v) == 0 and local_b % m == 0 and \
+                (v == 1 or m >= p)
+
+        # dp=2 × pp=2: each data shard runs its own 2-stage pipeline
+        if pp_ok(bs // 2):
+            out.append(("dp_pp", MeshConfig(data=2, pipeline=p)))
+        if heads % 2 == 0 and hidden % 2 == 0 and n_devices % 8 == 0:
+            out.append(("dp_tp", MeshConfig(data=4, tensor=2)))
+        e = cfg.model.vit_num_experts
+        if e > 0 and e % 2 == 0 and pp_ok(bs // 2):
+            out.append(("dp_pp_ep",
+                        MeshConfig(data=2, pipeline=2, expert=2)))
+    return out
+
+
+def _axis_product(mesh_cfg) -> int:
+    return math.prod(max(1, s) for s in (
+        mesh_cfg.data, mesh_cfg.fsdp, mesh_cfg.tensor, mesh_cfg.pipeline,
+        mesh_cfg.sequence, mesh_cfg.expert))
+
+
+def _abstract_batch(cfg, batch_size: int):
+    """Shape/dtype skeleton of one host batch as the input pipeline would
+    deliver it on this backend (float32 images after host-side prep)."""
+    import jax
+    if cfg.model.name == "logistic":
+        img = jax.ShapeDtypeStruct((batch_size, cfg.model.input_size),
+                                   np.float32)
+    else:
+        s = cfg.data.image_size
+        img = jax.ShapeDtypeStruct((batch_size, s, s, 3), np.float32)
+    lab = jax.ShapeDtypeStruct((batch_size,), np.int32)
+    return {"images": img, "labels": lab}
+
+
+def check_spec_tree(state_shapes, shardings, mesh,
+                    locus: str) -> Iterable[Finding]:
+    """Validate every leaf's PartitionSpec against its shape and the mesh:
+    spec rank ≤ array rank, and every named axis (product) divides its
+    dimension. This is the report that names the offending param path and
+    spec instead of a runtime ``_SpecError``."""
+    import jax
+    flat_shapes = jax.tree_util.tree_flatten_with_path(state_shapes)[0]
+    flat_shard = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    shard_by_path = {jax.tree_util.keystr(p): s for p, s in flat_shard}
+    for path, leaf in flat_shapes:
+        key = jax.tree_util.keystr(path)
+        sh = shard_by_path.get(key)
+        spec = getattr(sh, "spec", None)
+        if spec is None:
+            continue
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(spec) > len(shape):
+            yield Finding(
+                "elab-spec", locus, 0,
+                f"param {key}: spec {spec} has rank {len(spec)} but the "
+                f"leaf has shape {shape} (rank {len(shape)})")
+            continue
+        for d, names in enumerate(spec):
+            if names is None:
+                continue
+            names = names if isinstance(names, tuple) else (names,)
+            size = math.prod(mesh.shape.get(n, 1) for n in names)
+            if size and shape[d] % size:
+                yield Finding(
+                    "elab-spec", locus, 0,
+                    f"param {key}: spec {spec} maps dim {d} "
+                    f"(size {shape[d]}) onto mesh axes {names} of total "
+                    f"size {size}, which does not divide it")
+
+
+def elaborate_config(cfg, mesh_cfg, locus: str,
+                     trace_steps: bool = True,
+                     _state_cache: Optional[dict] = None) -> List[Finding]:
+    """Elaborate ONE (config, mesh layout): returns findings (empty=clean).
+
+    ``trace_steps=False`` skips the train/eval-step traces (the expensive
+    part) — used by run_elaborate for layouts whose step graph is
+    IDENTICAL to one already traced: a CNN's step does not read the mesh
+    at trace time (only jit placement does), so dp vs dp_fsdp re-traces
+    would buy nothing. Transformer configs re-trace per layout (the mesh
+    is baked into the pipeline/tensor/expert program). ``_state_cache``
+    memoizes the abstract state per batch-shard count for the same
+    reason."""
+    import jax
+    from ..parallel.mesh import batch_shard_count, create_mesh
+    from ..train.loop import Trainer
+    from ..train.state import abstract_train_state, state_shardings
+    from ..utils.config import stacked_layout_stamp
+
+    findings: List[Finding] = []
+    n = _axis_product(mesh_cfg)
+    devices = jax.devices()[:n]
+    if len(devices) < n:
+        return [Finding("elab-env", locus, 0,
+                        f"layout needs {n} devices but only "
+                        f"{len(devices)} present — run under "
+                        "utils.virtual_devices.apply_virtual_cpu")]
+    try:
+        mesh = create_mesh(mesh_cfg, devices=devices)
+        trainer = Trainer(cfg, mesh=mesh)
+    except Exception as e:
+        return [_findings_from_exc("elab-build", locus, "trainer build", e)]
+
+    try:
+        nb = batch_shard_count(mesh)
+        cache_key = (nb, cfg.model.name == "vit" and (
+            mesh.shape.get("pipeline", 1), mesh.shape.get("tensor", 1),
+            mesh.shape.get("expert", 1), mesh.shape.get("seq", 1)))
+        state_shapes = None if _state_cache is None \
+            else _state_cache.get(cache_key)
+        if state_shapes is None:
+            state_shapes = abstract_train_state(
+                trainer.model, trainer.tx,
+                (nb, cfg.data.image_size, cfg.data.image_size, 3)
+                if cfg.model.name != "logistic"
+                else (nb, cfg.model.input_size))
+            if _state_cache is not None:
+                _state_cache[cache_key] = state_shapes
+    except Exception as e:
+        return [_findings_from_exc("elab-state", locus, "state init", e)]
+
+    try:
+        shardings = state_shardings(state_shapes, mesh)
+        findings.extend(check_spec_tree(state_shapes, shardings, mesh,
+                                        locus))
+    except Exception as e:
+        findings.append(_findings_from_exc("elab-spec", locus,
+                                           "sharding rules", e))
+        return findings
+
+    # train step: trace fwd+bwd+optimizer abstractly. shard_map spec/rank
+    # mismatches, collective-axis errors and AD residual issues all fire
+    # at trace time (zero compute)
+    if trace_steps:
+        try:
+            batch = _abstract_batch(cfg, cfg.train.batch_size)
+            jax.eval_shape(trainer._train_step, state_shapes, batch)
+        except Exception as e:
+            findings.append(_findings_from_exc("elab-train-step", locus,
+                                               "train step", e))
+
+        # eval step: batch padded exactly as Trainer.evaluate pads it
+        # (batch shards × pipeline microbatches)
+        try:
+            pad_to = trainer.eval_pad_multiple()
+            ebs = cfg.data.eval_batch_size
+            ebs = ebs + (-ebs) % pad_to  # pad_batch_to_multiple contract
+            ebatch = _abstract_batch(cfg, ebs)
+            ebatch["mask"] = jax.ShapeDtypeStruct((ebs,), np.float32)
+            jax.eval_shape(trainer._eval_step, state_shapes, ebatch)
+        except Exception as e:
+            findings.append(_findings_from_exc("elab-eval-step", locus,
+                                               "eval step", e))
+
+    # restore contract: the layout stamp must compute, and every leaf path
+    # must be unique (the checkpoint manifest is keyed by flattened path)
+    try:
+        stacked_layout_stamp(cfg)
+        flat = jax.tree_util.tree_flatten_with_path(state_shapes)[0]
+        keys = [jax.tree_util.keystr(p) for p, _ in flat]
+        dupes = {k for k in keys if keys.count(k) > 1}
+        if dupes:
+            findings.append(Finding(
+                "elab-restore", locus, 0,
+                f"duplicate state leaf paths {sorted(dupes)[:3]} — the "
+                "checkpoint manifest cannot address them"))
+    except Exception as e:
+        findings.append(_findings_from_exc("elab-restore", locus,
+                                           "restore contract", e))
+    return findings
+
+
+def run_elaborate(preset_names: Optional[Sequence[str]] = None,
+                  n_devices: int = 8) -> List[Finding]:
+    """Elaborate the named presets (default: all) across their candidate
+    layouts. Call ``apply_virtual_cpu(n_devices)`` BEFORE the jax backend
+    initializes (main.py's ``check`` subcommand does)."""
+    import jax
+    from ..utils.config import PRESETS, get_preset
+
+    findings: List[Finding] = []
+    if len(jax.devices()) < n_devices:
+        return [Finding(
+            "elab-env", "environment", 0,
+            f"{len(jax.devices())} devices present, {n_devices} needed — "
+            "the check CLI must set up the virtual CPU mesh before jax "
+            "initializes")]
+    for name in (preset_names or sorted(PRESETS)):
+        cfg = get_preset(name)
+        state_cache: dict = {}
+        traced = False
+        for label, mesh_cfg in candidate_layouts(cfg, n_devices):
+            # the step graph only changes with PROGRAM-SHAPING axes
+            # (pipeline/tensor/expert/seq bake shard_maps into the model);
+            # dp vs dp_fsdp re-traces the identical graph, so trace once
+            # per distinct program and spec-check every layout
+            shaping = max(mesh_cfg.pipeline, 1) > 1 or \
+                max(mesh_cfg.tensor, 1) > 1 or \
+                max(mesh_cfg.expert, 1) > 1 or \
+                max(mesh_cfg.sequence, 1) > 1
+            trace = shaping or not traced
+            findings.extend(
+                elaborate_config(cfg, mesh_cfg, f"{name}@{label}",
+                                 trace_steps=trace,
+                                 _state_cache=state_cache))
+            traced = True
+    return findings

@@ -1,0 +1,146 @@
+"""Project-invariant linter driver.
+
+Walks the repository, parses every relevant file ONCE into a
+:class:`LintContext`, and runs each rule module from ``rules/`` over it.
+Rules are plain modules exposing ``RULE_NAME``, ``DOC`` and
+``check(ctx) -> Iterable[Finding]`` — adding a rule is adding a module
+and listing it in ``rules.ALL_RULES`` (docs/static_analysis.md).
+
+Suppression: a finding is dropped when its source line (or the line
+above) carries ``# shardcheck: ok`` or ``# shardcheck: ok(<rule-name>)``.
+Suppressions are for deliberate, reviewed exceptions — the comment is the
+audit trail.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .report import Finding
+
+PACKAGE = "distributed_resnet_tensorflow_tpu"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shardcheck:\s*ok(?:\(\s*(?P<rules>[\w\-, ]+)\s*\))?")
+
+
+@dataclass
+class SourceFile:
+    """One parsed file. ``tree`` is None for non-Python files (and for
+    Python files with syntax errors, which become their own finding)."""
+
+    path: str                    # absolute
+    rel: str                     # repo-relative (what findings report)
+    text: str
+    tree: Optional[ast.AST] = None
+
+    @property
+    def lines(self) -> List[str]:
+        return self.text.splitlines()
+
+
+@dataclass
+class LintContext:
+    root: str
+    package_py: List[SourceFile] = field(default_factory=list)
+    top_py: List[SourceFile] = field(default_factory=list)     # repo-root *.py
+    scripts: List[SourceFile] = field(default_factory=list)    # scripts/*.sh
+    docs: List[SourceFile] = field(default_factory=list)       # docs/*.md + README
+    parse_errors: List[Finding] = field(default_factory=list)
+
+    def all_python(self) -> List[SourceFile]:
+        return self.package_py + self.top_py
+
+
+def repo_root() -> str:
+    """The repository root = parent of the package directory."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def _load(path: str, root: str, python: bool,
+          errors: List[Finding]) -> SourceFile:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(path, root)
+    sf = SourceFile(path=path, rel=rel, text=text)
+    if python:
+        try:
+            sf.tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            errors.append(Finding("syntax-error", rel, e.lineno or 0,
+                                  f"unparseable python: {e.msg}"))
+    return sf
+
+
+def build_context(root: Optional[str] = None) -> LintContext:
+    root = root or repo_root()
+    ctx = LintContext(root=root)
+    pkg_dir = os.path.join(root, PACKAGE)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                ctx.package_py.append(_load(os.path.join(dirpath, fn), root,
+                                            True, ctx.parse_errors))
+    for fn in sorted(os.listdir(root)):
+        if fn.endswith(".py"):
+            ctx.top_py.append(_load(os.path.join(root, fn), root, True,
+                                    ctx.parse_errors))
+    scripts_dir = os.path.join(root, "scripts")
+    if os.path.isdir(scripts_dir):
+        for fn in sorted(os.listdir(scripts_dir)):
+            if fn.endswith(".sh"):
+                ctx.scripts.append(_load(os.path.join(scripts_dir, fn), root,
+                                         False, ctx.parse_errors))
+    docs_dir = os.path.join(root, "docs")
+    if os.path.isdir(docs_dir):
+        for fn in sorted(os.listdir(docs_dir)):
+            if fn.endswith(".md"):
+                ctx.docs.append(_load(os.path.join(docs_dir, fn), root,
+                                      False, ctx.parse_errors))
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        ctx.docs.append(_load(readme, root, False, ctx.parse_errors))
+    return ctx
+
+
+def _suppressed(sf: SourceFile, finding: Finding) -> bool:
+    """True when the finding's line (or the line above it) carries a
+    ``# shardcheck: ok`` marker naming no rule or this rule."""
+    if not finding.line:
+        return False
+    lines = sf.lines
+    for ln in (finding.line, finding.line - 1):
+        if 1 <= ln <= len(lines):
+            m = _SUPPRESS_RE.search(lines[ln - 1])
+            if m:
+                named = m.group("rules")
+                if named is None:
+                    return True
+                if finding.rule in {r.strip() for r in named.split(",")}:
+                    return True
+    return False
+
+
+def run_lint(root: Optional[str] = None,
+             rule_names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run every (or the named) lint rule; returns unsuppressed findings."""
+    from . import rules as rules_pkg
+    ctx = build_context(root)
+    by_rel: Dict[str, SourceFile] = {
+        sf.rel: sf for sf in
+        ctx.package_py + ctx.top_py + ctx.scripts + ctx.docs}
+    findings = list(ctx.parse_errors)
+    for mod in rules_pkg.ALL_RULES:
+        if rule_names and mod.RULE_NAME not in rule_names:
+            continue
+        for f in mod.check(ctx):
+            sf = by_rel.get(f.path)
+            if sf is not None and _suppressed(sf, f):
+                continue
+            findings.append(f)
+    return findings

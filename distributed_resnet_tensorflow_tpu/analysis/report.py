@@ -1,0 +1,42 @@
+"""Shared finding type + report formatting for lint and elaboration."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One problem located at ``path:line`` (line 0 = whole-artifact
+    findings, e.g. an elaboration failure of a preset × mesh layout)."""
+
+    rule: str      # rule id, e.g. "stray-device-put" or "elab-train-step"
+    path: str      # repo-relative file path, or "<preset>@<layout>" locus
+    line: int      # 1-based; 0 when no source line applies
+    message: str
+    detail: str = field(default="", compare=False)  # long context, optional
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+def format_findings(findings: Sequence[Finding],
+                    verbose: bool = False) -> str:
+    """Human-readable report: findings grouped by rule, stable order."""
+    if not findings:
+        return "shardcheck: 0 findings"
+    by_rule: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    out = [f"shardcheck: {len(findings)} finding(s) in "
+           f"{len(by_rule)} rule(s)"]
+    for rule in sorted(by_rule):
+        out.append(f"\n[{rule}] ({len(by_rule[rule])})")
+        for f in sorted(by_rule[rule], key=lambda x: (x.path, x.line)):
+            loc = f"{f.path}:{f.line}" if f.line else f.path
+            out.append(f"  {loc}: {f.message}")
+            if verbose and f.detail:
+                for ln in f.detail.splitlines():
+                    out.append(f"    | {ln}")
+    return "\n".join(out)

@@ -1,0 +1,19 @@
+"""Lint rule registry — one module per invariant.
+
+Each rule module exposes ``RULE_NAME`` (the id findings carry and
+suppressions name), ``DOC`` (one paragraph: the invariant and the
+incident it encodes), and ``check(ctx) -> Iterable[Finding]``.
+Adding a rule: create the module, append it to ``ALL_RULES``, add a
+known-bad fixture to tests/test_analysis.py and a row to the catalog in
+docs/static_analysis.md.
+"""
+from . import (bare_assert, cached_mesh, device_put, exit_codes,
+               registry_drift)
+
+ALL_RULES = (
+    device_put,
+    cached_mesh,
+    bare_assert,
+    exit_codes,
+    registry_drift,
+)

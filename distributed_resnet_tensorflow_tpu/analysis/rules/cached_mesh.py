@@ -1,0 +1,100 @@
+"""cached-mesh: no lru_cache/cache on functions that can receive Mesh or
+device objects.
+
+The PR 1 leak: an ``functools.lru_cache`` keyed (even transitively) on a
+``jax.sharding.Mesh`` pins the mesh AND its device arrays for the process
+lifetime — in sessions that build many meshes (tests, notebooks, per-round
+benches) that is an unbounded leak. The codebase's pattern is a weak-key
+``WeakKeyDictionary`` memo instead (parallel/mesh.py process_batch_slice,
+parallel/sharding.py _UNPACK_CACHE). This rule flags
+``functools.lru_cache``/``functools.cache`` decorating (or directly
+wrapping) a function whose parameter names or annotations say it can hold
+a mesh/device/sharding.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..report import Finding
+
+RULE_NAME = "cached-mesh"
+DOC = __doc__
+
+# a parameter named (or annotated) like these can hold device-pinning state
+_SUSPECT_TOKENS = ("mesh", "device", "sharding")
+
+
+def _cache_decorator(node: ast.expr) -> Optional[str]:
+    """'lru_cache'/'cache' when the expression is that decorator (bare,
+    attribute, or called form); None otherwise."""
+    target = node.func if isinstance(node, ast.Call) else node
+    if isinstance(target, ast.Attribute) and \
+            target.attr in ("lru_cache", "cache"):
+        return target.attr
+    if isinstance(target, ast.Name) and target.id in ("lru_cache", "cache"):
+        return target.id
+    return None
+
+
+def _suspect_param(fn) -> Optional[str]:
+    """First suspect parameter of a FunctionDef/AsyncFunctionDef/Lambda."""
+    args = list(fn.args.posonlyargs) + list(fn.args.args) + \
+        list(fn.args.kwonlyargs)
+    for a in args:
+        name = a.arg.lower()
+        ann = ast.dump(a.annotation).lower() \
+            if getattr(a, "annotation", None) else ""
+        for tok in _SUSPECT_TOKENS:
+            if tok in name or tok in ann:
+                return a.arg
+    return None
+
+
+def _finding(sf, lineno: int, kind: str, fn_name: str,
+             param: str) -> Finding:
+    return Finding(
+        RULE_NAME, sf.rel, lineno,
+        f"functools.{kind} on {fn_name}() whose parameter {param!r} can "
+        "hold a Mesh/device — this pins device arrays for the process "
+        "lifetime; use a WeakKeyDictionary memo "
+        "(parallel/mesh.py process_batch_slice)")
+
+
+def check(ctx) -> Iterable[Finding]:
+    for sf in ctx.all_python():
+        if sf.tree is None:
+            continue
+        # module-level functions by name, for resolving the direct-wrap
+        # form `memo = lru_cache(...)(make_fn)`
+        fn_defs = {n.name: n for n in ast.walk(sf.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    kind = _cache_decorator(dec)
+                    if kind is None:
+                        continue
+                    param = _suspect_param(node)
+                    if param is not None:
+                        yield _finding(sf, dec.lineno, kind, node.name,
+                                       param)
+            elif isinstance(node, ast.Call) and len(node.args) == 1:
+                # direct wrap: lru_cache(...)(fn) / cache(fn)
+                kind = _cache_decorator(node.func) \
+                    if isinstance(node.func, ast.Call) else \
+                    _cache_decorator(node)
+                if kind is None:
+                    continue
+                target = node.args[0]
+                wrapped = None
+                if isinstance(target, ast.Name):
+                    wrapped = fn_defs.get(target.id)
+                elif isinstance(target, ast.Lambda):
+                    wrapped = target
+                if wrapped is None:
+                    continue
+                param = _suspect_param(wrapped)
+                if param is not None:
+                    name = getattr(wrapped, "name", "<lambda>")
+                    yield _finding(sf, node.lineno, kind, name, param)

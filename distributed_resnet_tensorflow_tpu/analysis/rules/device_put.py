@@ -1,0 +1,46 @@
+"""stray-device-put: all host→device transfers live in parallel/sharding.py.
+
+The overlapped input pipeline's thread-safety story (docs/input_pipeline.md)
+rests on knowing exactly where transfers are issued: the coalesced hot path
+funnels through ``_issue_device_put`` (so tests can count one transfer per
+batch) and every other placement goes through ``put_to_sharding`` in the
+same module. A ``jax.device_put`` sprinkled anywhere else silently escapes
+transfer accounting, dtype coercion (``coerce_batch_dtypes``), and the
+single-issue audit — so any call outside ``parallel/sharding.py`` is a
+finding. Deliberate exceptions carry ``# shardcheck: ok(stray-device-put)``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Finding
+
+RULE_NAME = "stray-device-put"
+DOC = __doc__
+
+ALLOWED_FILES = (
+    "distributed_resnet_tensorflow_tpu/parallel/sharding.py",
+)
+
+
+def _is_device_put(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "device_put":
+        return True  # jax.device_put / anything.device_put
+    if isinstance(fn, ast.Name) and fn.id == "device_put":
+        return True  # from jax import device_put
+    return False
+
+
+def check(ctx) -> Iterable[Finding]:
+    for sf in ctx.all_python():
+        if sf.tree is None or sf.rel in ALLOWED_FILES:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and _is_device_put(node):
+                yield Finding(
+                    RULE_NAME, sf.rel, node.lineno,
+                    "jax.device_put outside parallel/sharding.py — route "
+                    "through put_to_sharding (or the coalesced stager) so "
+                    "transfers stay auditable in one module")

@@ -1,0 +1,56 @@
+"""exit-code-contract: process exit codes come from the declared registry.
+
+Launchers key requeue-vs-fail decisions off exit codes (docs/resilience.md:
+0 = done, 75 = resumable/requeue, 1 = real failure). A stray
+``sys.exit(3)`` silently breaks that protocol — SLURM would treat a
+resumable condition as a hard failure or vice versa. This rule flags any
+``sys.exit``/``os._exit`` whose argument is an integer literal not in
+``resilience.EXIT_CONTRACT``. Named constants (RESUMABLE_EXIT_CODE,
+FAILURE_EXIT_CODE) and computed codes (exit-code pass-through in
+launchers) are accepted — the contract is about new literals.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Finding
+
+RULE_NAME = "exit-code-contract"
+DOC = __doc__
+
+
+def _contract_codes() -> set:
+    from ...resilience import EXIT_CONTRACT
+    return set(EXIT_CONTRACT)
+
+
+def _is_exit_call(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in ("exit", "_exit"):
+        base = fn.value
+        return isinstance(base, ast.Name) and base.id in ("sys", "os")
+    return False
+
+
+def check(ctx) -> Iterable[Finding]:
+    codes = _contract_codes()
+    for sf in ctx.all_python():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_exit_call(node)):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, int) and \
+                    not isinstance(arg.value, bool) and \
+                    arg.value not in codes:
+                yield Finding(
+                    RULE_NAME, sf.rel, node.lineno,
+                    f"exit code {arg.value} is not in the declared "
+                    f"contract {sorted(codes)} (resilience.EXIT_CONTRACT) "
+                    "— launchers cannot classify it; declare it or reuse "
+                    "an existing code")

@@ -1,0 +1,109 @@
+"""event-registry + config-knob: names in code/scripts/docs must resolve.
+
+Two drift checks against the project's declared registries:
+
+  * every ``write_event("<name>", ...)`` literal in code and every
+    ``{"event": "<name>"}`` mention in docs/scripts must be declared in
+    ``utils.metrics.EVENT_SCHEMAS`` — the one source of truth for the
+    metrics.jsonl event stream;
+  * every ``--set a.b.c=`` knob referenced in code, scripts or docs must
+    resolve against the ``utils.config.ExperimentConfig`` dataclasses —
+    the knob a README advertises must actually exist (``cfg.override``
+    raises at runtime, but docs and sbatch scripts never run under CI).
+
+Both catch the "renamed it in code, forgot the docs/launcher" class that
+otherwise surfaces as a crashed job after a 20-minute queue wait.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterable
+
+from ..report import Finding
+
+RULE_NAME = "registry-drift"
+DOC = __doc__
+
+# documentation placeholders, not real knobs ("--set k=v", "--set
+# dotted.path=value" in usage strings)
+_KNOB_PLACEHOLDERS = {"k", "key", "KEY", "a.b.c", "dotted.path", "x.y.z"}
+
+# two reference shapes: a concrete override (requires the trailing "=" so
+# usage prose like "--set expects KEY=VALUE" stays quiet) and a wildcard
+# section reference ("--set resilience.watchdog.*", no "=" required)
+_KNOB_RE = re.compile(
+    r'--set[\s"=]+(?:([A-Za-z_][\w.]*\.\*)|([A-Za-z_][\w.]*)\s*=)')
+_DOC_EVENT_RE = re.compile(r'"event"\s*:\s*"(\w+)"')
+
+
+def _event_names() -> set:
+    from ...utils.metrics import EVENT_SCHEMAS
+    return set(EVENT_SCHEMAS)
+
+
+def _knob_resolves(dotted: str) -> bool:
+    from ...utils.config import ExperimentConfig
+    cur = ExperimentConfig()
+    for part in dotted.split("."):
+        if part == "*":
+            # wildcard tail ("resilience.watchdog.*") — the prefix must be
+            # a config section (dataclass), not a leaf
+            return dataclasses.is_dataclass(cur)
+        if not dataclasses.is_dataclass(cur) or not hasattr(cur, part):
+            return False
+        cur = getattr(cur, part)
+    return True
+
+
+def _is_write_event(node: ast.Call) -> bool:
+    fn = node.func
+    return isinstance(fn, ast.Attribute) and \
+        fn.attr in ("write_event", "_write_event")
+
+
+def check(ctx) -> Iterable[Finding]:
+    events = _event_names()
+
+    # (a) write_event literals in python
+    for sf in ctx.all_python():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and _is_write_event(node) \
+                    and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value not in events:
+                    yield Finding(
+                        RULE_NAME, sf.rel, node.lineno,
+                        f"metrics event {arg.value!r} is not declared in "
+                        "utils.metrics.EVENT_SCHEMAS — register it there "
+                        "first")
+
+    # (b) {"event": "<name>"} mentions in docs + scripts
+    for sf in ctx.docs + ctx.scripts:
+        for i, line in enumerate(sf.lines, 1):
+            for m in _DOC_EVENT_RE.finditer(line):
+                if m.group(1) not in events:
+                    yield Finding(
+                        RULE_NAME, sf.rel, i,
+                        f"documented metrics event {m.group(1)!r} does not "
+                        "exist in utils.metrics.EVENT_SCHEMAS — stale doc "
+                        "or missing registration")
+
+    # (c) --set knob references everywhere
+    for sf in ctx.all_python() + ctx.scripts + ctx.docs:
+        for i, line in enumerate(sf.lines, 1):
+            for m in _KNOB_RE.finditer(line):
+                knob = m.group(1) or m.group(2)
+                if knob in _KNOB_PLACEHOLDERS:
+                    continue
+                if not _knob_resolves(knob):
+                    yield Finding(
+                        RULE_NAME, sf.rel, i,
+                        f"--set {knob}=... does not resolve against the "
+                        "ExperimentConfig dataclasses (utils/config.py) — "
+                        "typo or renamed knob")

@@ -592,11 +592,25 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO, force=True,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "check":
+        # shardcheck gate (analysis/): lint + static elaboration on a
+        # virtual CPU mesh — no cluster, no data (docs/static_analysis.md)
+        from .analysis.cli import main_check
+        sys.exit(main_check(argv[1:]))
     # honor JAX_PLATFORMS even when a site plugin (e.g. this environment's
     # axon sitecustomize) overrode it via jax.config at interpreter start
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     cfg = parse_args(argv)
+    if cfg.analysis.dispatch_sanitizer:
+        # opt-in cross-thread dispatch guard (analysis/dispatch_sanitizer):
+        # a second dispatching thread raises at its call site instead of
+        # deadlocking the next collective
+        from .analysis.dispatch_sanitizer import install as _install_ds
+        _install_ds()
+        log.info("dispatch sanitizer armed (analysis.dispatch_sanitizer)")
     initialize_from_config(cfg.mesh)
     log.info("devices: %d (%d processes)", jax.device_count(),
              jax.process_count())

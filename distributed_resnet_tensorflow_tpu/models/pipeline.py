@@ -61,6 +61,16 @@ from jax.sharding import PartitionSpec as P
 _LN_EPS = 1e-6  # nn.LayerNorm default
 
 
+def resolve_microbatches(microbatches: int, pstages: int) -> int:
+    """The ONE home of the microbatch default (0 → 2 × stages, the GPipe
+    sweet spot at bubble (P-1)/(M+P-1)). Shared by the encoder itself,
+    Trainer.eval_pad_multiple (eval batches must pad to shards × M) and
+    the static elaborator's layout filter — three callers that must agree
+    or eval crashes with 'local batch must be a multiple of microbatches'
+    at step 1."""
+    return microbatches or 2 * pstages
+
+
 def _layer_norm(x, scale, bias):
     xf = x.astype(jnp.float32)
     mean = xf.mean(-1, keepdims=True)
@@ -355,7 +365,7 @@ class PipelinedEncoder(nn.Module):
                 raise ValueError(
                     f"mlp hidden {self.mlp_ratio * d} not divisible by "
                     f"tensor axis {tp}")
-        m = self.microbatches or 2 * pstages
+        m = resolve_microbatches(self.microbatches, pstages)
         if v > 1 and pstages > 1 and m < pstages:
             # the circular wrap takes M-P+1 ticks; M >= P keeps the stage-0
             # re-injection queue causally ahead of its consumption
@@ -407,10 +417,15 @@ class PipelinedEncoder(nn.Module):
         perm = [(i, (i + 1) % pstages) for i in range(pstages)]
 
         def _aux_reduce(aux_acc):
-            """Stage-local aux sums → one replicated scalar: sum stages,
+            """Stage-local aux sums → one replicated (1,)-vector: sum stages,
             mean over microbatches (matching the unpipelined batch-level
             scale) and over the batch (and token, under seq sharding)
-            shards."""
+            shards. Shape (1,) rather than scalar end-to-end: a rank-0
+            value at the shard_map boundary becomes a rank-0 residual
+            under AD, and jax 0.4.37's shard_map transpose assigns
+            residual cotangents axis names on dim 0 — a _SpecError for
+            scalars (the pp×ep MoE failure this comment documents; see
+            analysis/elaborate.py which now catches the class)."""
             aux = lax.psum(aux_acc, "pipeline") / m
             for ax in (_batch_axes(mesh) or ()):
                 aux = lax.pmean(aux, ax)
@@ -444,7 +459,7 @@ class PipelinedEncoder(nn.Module):
             zero = jnp.zeros((mb,) + xg.shape[1:], xg.dtype)
             out0 = jnp.zeros_like(xs)
             (last, out, aux_acc), _ = lax.scan(
-                tick, (zero, out0, jnp.float32(0.0)),
+                tick, (zero, out0, jnp.zeros((1,), jnp.float32)),
                 jnp.arange(m + pstages - 1))
             # outputs live on the last stage only; masked psum broadcasts
             out = lax.psum(
@@ -511,7 +526,7 @@ class PipelinedEncoder(nn.Module):
             (last, _wq, out, aux_acc), _ = lax.scan(
                 tick,
                 (zero, jnp.zeros_like(xs), jnp.zeros_like(xs),
-                 jnp.float32(0.0)),
+                 jnp.zeros((1,), jnp.float32)),
                 jnp.arange(v * m + pstages - 1))
             out = lax.psum(
                 jnp.where(stage == pstages - 1, out, jnp.zeros_like(out)),
@@ -521,9 +536,9 @@ class PipelinedEncoder(nn.Module):
         from ..parallel.mesh import shard_map_compat
         body = pipelined if v == 1 else pipelined_circular
         fn = shard_map_compat(body, mesh, in_specs=(p_spec, x_spec),
-                              out_specs=(x_spec, P()))
+                              out_specs=(x_spec, P(None)))
         y, aux = fn(params, x)
-        return finish(y, aux)
+        return finish(y, aux[0])
 
 
 def circular_layer_order(depth: int, pstages: int, interleave: int):

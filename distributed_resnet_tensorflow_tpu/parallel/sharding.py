@@ -273,6 +273,17 @@ def _issue_device_put(arrays, devices):
     return jax.device_put(arrays, devices)
 
 
+def put_to_sharding(tree, shardings):
+    """Generic host→device placement for the NON-coalesced paths (device
+    dataset upload, index batches, per-leaf fallback). This module is the
+    single home of ``jax.device_put``: every transfer either funnels
+    through ``_issue_device_put`` (coalesced hot path) or this thin
+    wrapper, so transfer accounting and the thread-safety story
+    (docs/input_pipeline.md) have exactly one file to audit — enforced by
+    ``analysis/rules/device_put.py`` (stray-device-put)."""
+    return jax.device_put(tree, shardings)
+
+
 def _device_batch_shards(mesh: Mesh):
     """[(device, batch_shard_id)] for this process's addressable devices,
     ordered by mesh position. shard_id = data_coord * fsdp_size + fsdp_coord

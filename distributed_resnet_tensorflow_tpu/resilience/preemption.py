@@ -30,6 +30,9 @@ log = logging.getLogger(__name__)
 #: sysexits.h EX_TEMPFAIL — "temporary failure; user is invited to retry".
 RESUMABLE_EXIT_CODE = 75
 
+#: a real (non-resumable) failure — launchers must NOT requeue
+FAILURE_EXIT_CODE = 1
+
 _DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
 

@@ -54,12 +54,9 @@ from typing import Callable, Dict, Optional
 
 from .heartbeat import (Beat, BeatTransport, DEPARTED_PHASES,
                         HeartbeatPublisher, MONITORED_PHASES, PHASE_FAILED)
-from .preemption import RESUMABLE_EXIT_CODE
+from .preemption import FAILURE_EXIT_CODE, RESUMABLE_EXIT_CODE  # noqa: F401
 
 log = logging.getLogger(__name__)
-
-#: exit code for a peer that died on a real (non-resumable) error
-FAILURE_EXIT_CODE = 1
 
 
 def watchdog_enabled(wd_cfg, process_count: int) -> bool:

@@ -457,10 +457,11 @@ class Trainer:
             self._jitted_train = None
             self._jitted_multi = None
         from ..parallel.mesh import replicated
+        from ..parallel.sharding import put_to_sharding
         rep = replicated(self.mesh)
         import numpy as np
-        self._dev_data = (jax.device_put(np.asarray(images), rep),
-                          jax.device_put(np.asarray(labels), rep))
+        self._dev_data = (put_to_sharding(np.asarray(images), rep),
+                          put_to_sharding(np.asarray(labels), rep))
         self._jitted_idx = None
         self._jitted_idx_multi = None
 
@@ -488,7 +489,11 @@ class Trainer:
         return fn
 
     def jitted_index_step(self):
-        assert self._dev_data is not None
+        if self._dev_data is None:
+            # a RuntimeError (not assert): the guard must survive python -O
+            raise RuntimeError(
+                "jitted_index_step requires an attached device dataset "
+                "(attach_device_dataset)")
         if self._jitted_idx is None:
             from ..parallel.mesh import replicated
             shapes = jax.eval_shape(lambda s: s, self.state)
@@ -522,7 +527,10 @@ class Trainer:
 
     def jitted_index_multi_step(self, k: int = 0):
         del k
-        assert self._dev_data is not None
+        if self._dev_data is None:
+            raise RuntimeError(
+                "jitted_index_multi_step requires an attached device "
+                "dataset (attach_device_dataset)")
         if self._jitted_idx_multi is None:
             from ..parallel.mesh import replicated
             gathered = self._gathered_step()
@@ -550,11 +558,13 @@ class Trainer:
         return self._jitted_idx_multi
 
     def _put_idx(self, batch):
-        return jax.device_put(batch, {"idx": data_sharding(self.mesh)})
+        from ..parallel.sharding import put_to_sharding
+        return put_to_sharding(batch, {"idx": data_sharding(self.mesh)})
 
     def _put_idx_multi(self, batch):
+        from ..parallel.sharding import put_to_sharding
         sh = NamedSharding(self.mesh, P(None, *data_sharding(self.mesh).spec))
-        return jax.device_put(batch, {"idx": sh})
+        return put_to_sharding(batch, {"idx": sh})
 
     # -- resilience --------------------------------------------------------
     def scale_lr(self, scale: float) -> None:
@@ -735,6 +745,22 @@ class Trainer:
             entry[2] = [stacked, done] if done < k else None
         return self.state, metrics
 
+    def eval_pad_multiple(self) -> int:
+        """The multiple eval batches must pad to: the batch-shard count,
+        times the pipeline microbatch count when the encoder is pipelined
+        (each shard's LOCAL batch must divide into microbatches — the
+        PipelinedEncoder fails loudly otherwise). Found by the static
+        elaborator: the default eval_batch_size=100 over a dp=2 × pp=2
+        mesh left a local batch of 50 against 4 microbatches — a
+        guaranteed step-1 eval crash (analysis/elaborate.py)."""
+        n = batch_shard_count(self.mesh)
+        pstages = self.mesh.shape.get("pipeline", 1)
+        if self.cfg.model.name == "vit" and pstages > 1:
+            from ..models.pipeline import resolve_microbatches
+            n *= resolve_microbatches(
+                self.cfg.model.vit_pipeline_microbatches, pstages)
+        return n
+
     def evaluate(self, data_iter: Iterator, num_batches: int) -> Dict[str, float]:
         """Pipelined evaluation: padding + host→device staging run on the
         dedicated transfer thread (data/device_prefetch.device_prefetch)
@@ -746,7 +772,7 @@ class Trainer:
         from ..data.device_prefetch import device_prefetch
         from ..parallel.sharding import pad_batch_to_multiple
         step_fn = self.jitted_eval_step()
-        n_shards = batch_shard_count(self.mesh)
+        n_shards = self.eval_pad_multiple()
 
         def padded():
             for batch in data_iter:

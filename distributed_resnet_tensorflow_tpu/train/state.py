@@ -34,17 +34,7 @@ class TrainState(struct.PyTreeNode):
                             opt_state=new_opt_state)
 
 
-def create_train_state(rng: jax.Array, model, tx, input_shape,
-                       mesh: Mesh = None) -> TrainState:
-    """Initialize model + optimizer state.
-
-    When a mesh is given, init runs under jit with output shardings so large
-    params materialize directly sharded (never gathered on one host) — the
-    replacement for both replica_device_setter placement (reference
-    resnet_cifar_main.py:392-396) and Horovod's rank-0 variable broadcast
-    (reference resnet_cifar_main_horovod.py:316): replicated init is identical
-    on every process by seeded construction.
-    """
+def _make_init_fn(model, tx, input_shape):
     dummy = jnp.zeros(input_shape, jnp.float32)
 
     def init_fn(rng):
@@ -56,6 +46,30 @@ def create_train_state(rng: jax.Array, model, tx, input_shape,
                           batch_stats=batch_stats, opt_state=opt_state,
                           apply_fn=model.apply, tx=tx)
 
+    return init_fn
+
+
+def abstract_train_state(model, tx, input_shape) -> TrainState:
+    """Shape/dtype-only TrainState — zero data, zero compute. The static
+    elaborator (analysis/elaborate.py) builds model states for every
+    preset × mesh layout this way; create_train_state uses the same init
+    function, so the abstract state and the real one cannot drift."""
+    return jax.eval_shape(_make_init_fn(model, tx, input_shape),
+                          jax.random.PRNGKey(0))
+
+
+def create_train_state(rng: jax.Array, model, tx, input_shape,
+                       mesh: Mesh = None) -> TrainState:
+    """Initialize model + optimizer state.
+
+    When a mesh is given, init runs under jit with output shardings so large
+    params materialize directly sharded (never gathered on one host) — the
+    replacement for both replica_device_setter placement (reference
+    resnet_cifar_main.py:392-396) and Horovod's rank-0 variable broadcast
+    (reference resnet_cifar_main_horovod.py:316): replicated init is identical
+    on every process by seeded construction.
+    """
+    init_fn = _make_init_fn(model, tx, input_shape)
     if mesh is None:
         return init_fn(rng)
 

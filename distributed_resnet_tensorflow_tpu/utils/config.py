@@ -294,6 +294,19 @@ class ResilienceConfig:
 
 
 @dataclass
+class AnalysisConfig:
+    """Static-analysis / debug instrumentation (analysis/ subsystem;
+    docs/static_analysis.md). The ``check`` gate itself is config-free —
+    these knobs control the RUNTIME aids."""
+
+    # opt-in: raise at the call site the moment a second thread launches a
+    # multi-device XLA execution (the cross-thread dispatch deadlock class,
+    # docs/input_pipeline.md threading model) instead of wedging the next
+    # collective. Costs a lock per dispatch — debug runs, not production.
+    dispatch_sanitizer: bool = False
+
+
+@dataclass
 class EvalConfig:
     """Standalone polling evaluator (reference resnet_cifar_eval.py:85-141)."""
 
@@ -325,6 +338,7 @@ class ExperimentConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
+    analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
     mode: str = "train"               # train | eval | train_and_eval
     log_root: str = "/tmp/drt_tpu"    # reference log_root flag
 

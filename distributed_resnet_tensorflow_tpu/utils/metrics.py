@@ -96,6 +96,95 @@ class StageStats:
 input_stages = StageStats()
 
 
+#: The metrics.jsonl event registry — the ONE source of truth for every
+#: typed ``{"event": <name>, ...}`` record any part of the framework may
+#: emit. Each entry: {"fields": {field: one-line description},
+#: "emitted_by": module that writes it}. Scalar rows (step/time + metric
+#: keys, no "event" key) are not events and are not registered here.
+#:
+#: Contract, enforced two ways:
+#:   * statically — analysis/rules/registry_drift.py (event-registry)
+#:     resolves every ``write_event("name", ...)`` literal and every
+#:     ``{"event": "name"}`` mention in docs/ and scripts/ against this
+#:     dict, so code and documentation cannot drift apart;
+#:   * at runtime — ``MetricsWriter.write_event`` warns once per unknown
+#:     name (never raises: observability must not kill a training run).
+#:
+#: Adding an event = add it HERE first, then emit/document it.
+EVENT_SCHEMAS = {
+    "input_stages": {
+        "emitted_by": "train/hooks.py InputStagesHook",
+        "fields": {
+            "step": "step at export time",
+            "stages": "per-stage {count, items, seconds, "
+                      "max_thread_seconds, workers, bytes} — cumulative "
+                      "since process start/reset (difference consecutive "
+                      "rows for window rates)",
+        },
+    },
+    "corrupt_record": {
+        "emitted_by": "train/hooks.py CorruptRecordsHook",
+        "fields": {
+            "step": "step at export time",
+            "count": "distinct corrupt (file, offset) sites skipped",
+            "repeats": "re-reads of already-counted sites",
+            "by_reason": "per-reason breakdown",
+            "recent": "most recent offenders (file, reason)",
+        },
+    },
+    "heartbeat": {
+        "emitted_by": "resilience/watchdog.py (straggler_window cadence)",
+        "fields": {
+            "hosts": "per-process {step, progress, phase, host, age_secs}",
+        },
+    },
+    "straggler": {
+        "emitted_by": "resilience/watchdog.py (straggler_window cadence)",
+        "fields": {
+            "window_secs": "accounting window",
+            "rates": "per-process steps/sec over the window",
+            "median": "median step rate",
+            "lag_steps": "per-process steps behind the leader",
+            "flagged": "process ids slower than median by straggler_ratio",
+        },
+    },
+    "peer_lost": {
+        "emitted_by": "resilience/watchdog.py (detection verdict)",
+        "fields": {"detail": "human-readable verdict",
+                   "exit_code": "intended exit code (75)",
+                   "grace_secs": "grace window before hard exit",
+                   "via": "'collective_error' when classified from the "
+                          "main thread's exception path"},
+    },
+    "peer_failed": {
+        "emitted_by": "resilience/watchdog.py (detection verdict)",
+        "fields": {"detail": "human-readable verdict",
+                   "exit_code": "intended exit code (1)",
+                   "grace_secs": "grace window before hard exit",
+                   "via": "'collective_error' when classified from the "
+                          "main thread's exception path"},
+    },
+    "hang": {
+        "emitted_by": "resilience/watchdog.py (detection verdict)",
+        "fields": {"detail": "human-readable verdict",
+                   "exit_code": "intended exit code (75)",
+                   "grace_secs": "grace window before hard exit"},
+    },
+    "watchdog_cleared": {
+        "emitted_by": "resilience/watchdog.py",
+        "fields": {"kind": "the verdict that cleared within grace"},
+    },
+    "watchdog_exit": {
+        "emitted_by": "resilience/watchdog.py",
+        "fields": {"kind": "verdict kind", "exit_code": "code passed to "
+                   "os._exit", "detail": "human-readable verdict"},
+    },
+}
+
+# unknown event names already warned about (warn once, not per row)
+_UNKNOWN_EVENTS_WARNED: set = set()
+
+
 class MetricsWriter:
     """JSONL + optional TensorBoard scalar writer. Process-0-only by default
     (matching chief-only summaries in the reference)."""
@@ -148,7 +237,15 @@ class MetricsWriter:
     def write_event(self, event: str, payload: Dict[str, Any]) -> None:
         """Typed (non-scalar) JSONL record: ``{"event": <name>, ...}``.
         Consumers of metrics.jsonl that expect scalar rows must filter on
-        the "event" key (read_metrics returns both kinds)."""
+        the "event" key (read_metrics returns both kinds). ``event`` must
+        be declared in EVENT_SCHEMAS — unknown names still write (a
+        training run must not die on telemetry) but warn once."""
+        if event not in EVENT_SCHEMAS and event not in _UNKNOWN_EVENTS_WARNED:
+            _UNKNOWN_EVENTS_WARNED.add(event)
+            log.warning(
+                "metrics event %r is not declared in "
+                "utils.metrics.EVENT_SCHEMAS — register it (the "
+                "event-registry lint rejects undeclared literals)", event)
         rec = {"event": event, "time": time.time()}
         rec.update(payload)
         with self._wlock:

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Shardcheck gate — the seconds-fast correctness check that runs BEFORE a
+# cluster allocation is spent (docs/static_analysis.md):
+#
+#   * project-invariant lint (analysis/rules/): stray device_put, cached
+#     meshes, bare asserts, undeclared exit codes, metrics-event/config
+#     drift against the declared registries;
+#   * static elaboration (analysis/elaborate.py): every preset × mesh
+#     layout traced abstractly on a virtual CPU mesh — PartitionSpec,
+#     shape and config bugs surface here with the offending param path,
+#     not as a step-1 _SpecError after a 20-minute queue wait.
+#
+#   scripts/analysis_gate.sh               # full gate (lint + all presets)
+#   scripts/analysis_gate.sh --lint-only   # sub-second syntax/invariant pass
+#
+# Wired as a pre-submit step in scripts/submit_tpu_slurm.sh and into the
+# pre-merge chaos gate (scripts/chaos_smoke.sh --fast). Exit 0 = clean,
+# 1 = findings (per the resilience.EXIT_CONTRACT failure code).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# all presets is `check`'s default — not hardcoded here, so pass-through
+# args like `--preset smoke` or `--lint-only` scope the gate cleanly
+exec env JAX_PLATFORMS=cpu python -m distributed_resnet_tensorflow_tpu.main \
+  check "$@"

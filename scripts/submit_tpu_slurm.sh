@@ -20,6 +20,33 @@ shift || true
 LOG_ROOT="${LOG_ROOT:-logs/${SLURM_JOB_NAME:-drt}-${SLURM_JOB_ID:-local}}"
 mkdir -p "$LOG_ROOT"
 
+# Shardcheck gate (scripts/analysis_gate.sh): catch PartitionSpec /
+# config / invariant bugs in ~1 CPU-minute at allocation start, before
+# minutes of XLA compile + a step-1 crash burn the whole multi-node
+# allocation. (This batch script runs AFTER the queue wait — to spend
+# zero allocation time on a doomed config, run scripts/analysis_gate.sh
+# on the submit host before sbatch; this in-job gate is the backstop.)
+# Runs on the FIRST submission only — a preemption requeue re-enters this
+# script with the code already vetted, and the gate's virtual-CPU jax
+# startup would only delay the resume. SKIP_ANALYSIS_GATE=1 escapes a
+# broken submit host's python env.
+# NOTE: under sbatch, $0 is the SPOOLED copy of this script (slurmd spool
+# dir) — resolve the gate from the submit directory like every other
+# relative path here (LOG_ROOT), falling back to $0's dir for direct runs.
+GATE="${SLURM_SUBMIT_DIR:-$(dirname "$0")/..}/scripts/analysis_gate.sh"
+if [[ "${SLURM_RESTART_COUNT:-0}" == "0" && "${SKIP_ANALYSIS_GATE:-0}" != "1" ]]; then
+  if [[ -x "$GATE" ]]; then
+    "$GATE" || {
+      echo "shardcheck gate failed — fix the findings (or rerun with" \
+           "SKIP_ANALYSIS_GATE=1 if the gate itself is broken)"
+      exit 1
+    }
+  else
+    echo "shardcheck gate not found at $GATE — skipping (submit from the" \
+         "repo root for pre-run checking)"
+  fi
+fi
+
 # reference parity: optional checkpoint wipe via FRESH=1
 # (reference submit_cifar_daint_dist.sh:67-73). Guarded by
 # SLURM_RESTART_COUNT: a requeue after preemption re-runs this script with

@@ -1,0 +1,353 @@
+"""Shardcheck tests: every lint rule fires on a known-bad fixture (with
+file:line), the elaborator flags a deliberately mis-specced model, the
+REAL tree lints clean, and the dispatch sanitizer catches a cross-thread
+multi-device launch."""
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_resnet_tensorflow_tpu.analysis.lint import (
+    run_lint, repo_root)
+from distributed_resnet_tensorflow_tpu.analysis.report import (
+    Finding, format_findings)
+
+PKG = "distributed_resnet_tensorflow_tpu"
+
+
+# ---------------------------------------------------------------------------
+# known-bad fixture repo: one violation per rule, at a known line
+# ---------------------------------------------------------------------------
+
+BAD_PY = '''\
+import functools
+import os
+import sys
+
+import jax
+
+
+def stray(batch, sharding):
+    return jax.device_put(batch, sharding)          # line 9: stray-device-put
+
+
+@functools.lru_cache(maxsize=8)
+def cached(mesh, n):                                # line 13: cached-mesh
+    return n
+
+
+def guard(x):
+    assert x is not None                            # line 18: bare-assert
+    return x
+
+
+def leave():
+    sys.exit(3)                                     # line 23: exit-code-contract
+
+
+def tell(writer):
+    writer.write_event("made_up_event", {})         # line 27: registry-drift
+
+
+def build(mesh):
+    return mesh
+
+
+memo = functools.lru_cache(maxsize=None)(build)     # line 34: cached-mesh
+'''
+
+BAD_SH = '''\
+#!/bin/bash
+python -m distributed_resnet_tensorflow_tpu.main --set trian.batch_size=64
+# stale wildcard section reference (typo'd):
+#   tune it via --set resilience.watchdogg.*
+'''
+
+BAD_MD = '''\
+# stale doc
+Watch for `{"event": "vanished_event"}` rows.
+'''
+
+
+@pytest.fixture()
+def bad_repo(tmp_path):
+    pkg = tmp_path / PKG
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(BAD_PY)
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "scripts" / "bad.sh").write_text(BAD_SH)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "bad.md").write_text(BAD_MD)
+    return str(tmp_path)
+
+
+def _by_rule(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.rule, []).append(f)
+    return out
+
+
+def test_each_rule_fires_with_file_and_line(bad_repo):
+    by_rule = _by_rule(run_lint(bad_repo))
+    bad_py = os.path.join(PKG, "bad.py")
+
+    f = by_rule["stray-device-put"][0]
+    assert (f.path, f.line) == (bad_py, 9)
+    cached = {(f.path, f.line) for f in by_rule["cached-mesh"]}
+    assert (bad_py, 12) in cached            # decorator form
+    assert (bad_py, 34) in cached            # direct-wrap form
+    f = by_rule["bare-assert"][0]
+    assert (f.path, f.line) == (bad_py, 18)
+    f = by_rule["exit-code-contract"][0]
+    assert (f.path, f.line) == (bad_py, 23)
+    drift = {(f.path, f.line) for f in by_rule["registry-drift"]}
+    assert (bad_py, 27) in drift                       # undeclared event
+    assert (os.path.join("scripts", "bad.sh"), 2) in drift  # bad --set knob
+    assert (os.path.join("scripts", "bad.sh"), 4) in drift  # bad wildcard
+    assert (os.path.join("docs", "bad.md"), 2) in drift     # stale doc event
+
+
+def test_suppression_comment_silences_rule(bad_repo):
+    path = os.path.join(bad_repo, PKG, "bad.py")
+    with open(path) as f:
+        src = f.read()
+    src = src.replace("assert x is not None",
+                      "assert x is not None  # shardcheck: ok(bare-assert)")
+    with open(path, "w") as f:
+        f.write(src)
+    by_rule = _by_rule(run_lint(bad_repo))
+    assert "bare-assert" not in by_rule
+    # a suppression naming ANOTHER rule must not silence this one
+    src = src.replace("# shardcheck: ok(bare-assert)",
+                      "# shardcheck: ok(cached-mesh)")
+    with open(path, "w") as f:
+        f.write(src)
+    assert "bare-assert" in _by_rule(run_lint(bad_repo))
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    pkg = tmp_path / PKG
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def nope(:\n")
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    assert "syntax-error" in by_rule
+
+
+def test_real_tree_lints_clean():
+    findings = run_lint(repo_root())
+    assert findings == [], format_findings(findings, verbose=True)
+
+
+def test_format_findings_groups_by_rule():
+    out = format_findings([
+        Finding("r1", "a.py", 3, "one"),
+        Finding("r2", "b.py", 0, "two"),
+        Finding("r1", "a.py", 9, "three"),
+    ])
+    assert "2 rule(s)" in out and "a.py:3" in out and "b.py: two" in out
+
+
+# ---------------------------------------------------------------------------
+# registries
+# ---------------------------------------------------------------------------
+
+def test_event_registry_covers_every_emitted_literal():
+    """Every write_event literal in the real tree must be declared — the
+    registry-drift rule enforces it, so a clean run implies coverage; this
+    pins the registry itself against accidental deletion."""
+    from distributed_resnet_tensorflow_tpu.utils.metrics import EVENT_SCHEMAS
+    for name in ("input_stages", "corrupt_record", "heartbeat", "straggler",
+                 "peer_lost", "peer_failed", "hang", "watchdog_cleared",
+                 "watchdog_exit"):
+        assert name in EVENT_SCHEMAS
+        assert EVENT_SCHEMAS[name]["fields"], name
+
+
+def test_write_event_warns_once_on_undeclared(tmp_path, caplog):
+    from distributed_resnet_tensorflow_tpu.utils import metrics as m
+    w = m.MetricsWriter(str(tmp_path), enable_tensorboard=False)
+    with caplog.at_level("WARNING"):
+        w.write_event("not_a_real_event_xyz", {"a": 1})
+        w.write_event("not_a_real_event_xyz", {"a": 2})
+        w.write_event("straggler", {"median": 1.0})
+    w.close()
+    warned = [r for r in caplog.records if "not_a_real_event_xyz" in r.message]
+    assert len(warned) == 1          # once, not per row
+    rows = m.read_metrics(str(tmp_path))
+    assert [r.get("event") for r in rows] == \
+        ["not_a_real_event_xyz", "not_a_real_event_xyz", "straggler"]
+
+
+def test_config_knob_resolution():
+    from distributed_resnet_tensorflow_tpu.analysis.rules.registry_drift \
+        import _knob_resolves
+    assert _knob_resolves("train.batch_size")
+    assert _knob_resolves("resilience.watchdog.peer_timeout_secs")
+    assert _knob_resolves("resilience.watchdog.*")
+    assert _knob_resolves("analysis.dispatch_sanitizer")
+    assert not _knob_resolves("trian.batch_size")
+    assert not _knob_resolves("train.batch_sizes")
+    assert not _knob_resolves("train.batch_size.*")  # leaf is not a section
+
+
+def test_exit_contract_registry():
+    from distributed_resnet_tensorflow_tpu.resilience import (
+        EXIT_CONTRACT, FAILURE_EXIT_CODE, RESUMABLE_EXIT_CODE)
+    assert set(EXIT_CONTRACT) == {0, FAILURE_EXIT_CODE, RESUMABLE_EXIT_CODE}
+
+
+# ---------------------------------------------------------------------------
+# elaborator
+# ---------------------------------------------------------------------------
+
+def test_spec_checker_flags_misspecced_leaf(mesh8):
+    from distributed_resnet_tensorflow_tpu.analysis.elaborate import (
+        check_spec_tree)
+    shapes = {"w": jax.ShapeDtypeStruct((6, 4), np.float32),
+              "b": jax.ShapeDtypeStruct((4,), np.float32)}
+    shardings = {"w": NamedSharding(mesh8, P("data")),   # 6 % 8 != 0 — bad
+                 "b": NamedSharding(mesh8, P())}
+    findings = list(check_spec_tree(shapes, shardings, mesh8, "fixture"))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "elab-spec" and "'w'" in f.message \
+        and "data" in f.message and "(6, 4)" not in f.message
+    # rank overflow is its own message
+    shardings["b"] = NamedSharding(mesh8, P(None, "data"))
+    msgs = [f.message for f in
+            check_spec_tree(shapes, shardings, mesh8, "fixture")]
+    assert any("rank" in m for m in msgs)
+
+
+def _tiny_vit_cfg(**model_kw):
+    from distributed_resnet_tensorflow_tpu.utils.config import (
+        ExperimentConfig, ModelConfig, DataConfig, OptimizerConfig,
+        TrainConfig)
+    cfg = ExperimentConfig()
+    cfg.model = ModelConfig(name="vit", num_classes=10, vit_patch_size=8,
+                            vit_dim=32, vit_depth=4, vit_heads=4,
+                            compute_dtype="float32",
+                            attention_impl="dense", **model_kw)
+    cfg.data = DataConfig(dataset="synthetic", image_size=32)
+    cfg.optimizer = OptimizerConfig(name="adam", schedule="constant")
+    cfg.train = TrainConfig(batch_size=8, train_steps=10)
+    return cfg
+
+
+def test_elaborator_flags_misspecced_model(devices):
+    """The deliberately mis-specced fixture: pipeline microbatches that
+    cannot divide the local batch — the elaborator must name the train
+    step and the divisibility, without touching a device."""
+    from distributed_resnet_tensorflow_tpu.analysis.elaborate import (
+        elaborate_config)
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+    cfg = _tiny_vit_cfg(vit_pipeline_microbatches=3)
+    cfg.train.batch_size = 8          # local batch 4 over dp=2, 4 % 3 != 0
+    findings = elaborate_config(cfg, MeshConfig(data=2, pipeline=2),
+                                "fixture@dp_pp")
+    rules = {f.rule for f in findings}
+    assert "elab-train-step" in rules, format_findings(findings, True)
+    msg = next(f for f in findings if f.rule == "elab-train-step").message
+    assert "microbatches" in msg
+
+
+def test_elaborator_clean_on_valid_pipeline_moe(devices):
+    """pp×ep MoE elaborates clean — the configuration whose _SpecError
+    this subsystem was built to catch (and whose fix it located)."""
+    from distributed_resnet_tensorflow_tpu.analysis.elaborate import (
+        elaborate_config)
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+    cfg = _tiny_vit_cfg(vit_num_experts=4, vit_expert_capacity_factor=4.0)
+    findings = elaborate_config(
+        cfg, MeshConfig(data=2, pipeline=2, expert=2), "fixture@dp_pp_ep")
+    assert findings == [], format_findings(findings, verbose=True)
+
+
+def test_elaborator_clean_on_smoke_preset(devices):
+    from distributed_resnet_tensorflow_tpu.analysis.elaborate import (
+        run_elaborate)
+    findings = run_elaborate(["smoke"])
+    assert findings == [], format_findings(findings, verbose=True)
+
+
+def test_check_cli_lint_only():
+    from distributed_resnet_tensorflow_tpu.main import main
+    with pytest.raises(SystemExit) as e:
+        main(["check", "--lint-only"])
+    assert e.value.code == 0
+
+
+# ---------------------------------------------------------------------------
+# dispatch sanitizer
+# ---------------------------------------------------------------------------
+
+def test_dispatch_sanitizer_catches_cross_thread_launch(mesh8):
+    from distributed_resnet_tensorflow_tpu.analysis import (
+        dispatch_sanitizer as ds)
+    rep = NamedSharding(mesh8, P())
+    multi = jax.jit(lambda x: x + 1, out_shardings=rep)
+    x = jnp.zeros((8,), jnp.float32)
+    multi(x).block_until_ready()      # compile OUTSIDE the guard
+    single = jax.jit(lambda x: x * 2)
+    single(x).block_until_ready()
+    with ds.enabled():
+        multi(x).block_until_ready()  # main thread claims ownership
+        multi(x).block_until_ready()  # same thread: fine
+        errs = []
+
+        def other():
+            try:
+                multi(x).block_until_ready()
+            except Exception as e:    # noqa: BLE001 - collected for assert
+                errs.append(e)
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert len(errs) == 1 and \
+            isinstance(errs[0], ds.CrossThreadDispatchError)
+        assert "consumer thread" in str(errs[0]) or \
+            "docs/input_pipeline.md" in str(errs[0])
+
+        # single-device launches are never restricted
+        errs2 = []
+
+        def other_single():
+            try:
+                single(x).block_until_ready()
+            except Exception as e:    # noqa: BLE001
+                errs2.append(e)
+
+        t2 = threading.Thread(target=other_single)
+        t2.start()
+        t2.join()
+        assert errs2 == []
+
+        # an explicit handoff re-opens ownership
+        ds.reset_owner()
+        errs3 = []
+
+        def new_owner():
+            try:
+                multi(x).block_until_ready()
+            except Exception as e:    # noqa: BLE001
+                errs3.append(e)
+
+        t3 = threading.Thread(target=new_owner)
+        t3.start()
+        t3.join()
+        assert errs3 == []
+    assert not ds.is_installed()
+    multi(x).block_until_ready()      # uninstalled: unrestricted again
+
+
+def test_dispatch_sanitizer_config_knob():
+    from distributed_resnet_tensorflow_tpu.utils.config import parse_args
+    cfg = parse_args(["--preset", "smoke",
+                      "--set", "analysis.dispatch_sanitizer=true"])
+    assert cfg.analysis.dispatch_sanitizer is True

@@ -110,6 +110,7 @@ def test_raw_iterator_and_device_augment_train_step(tmp_path):
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1; runs in the full (unfiltered) suite
 @pytest.mark.heavy
 def test_device_dataset_matches_streamed_path(tmp_path):
     """HBM-resident dataset + index batches == streamed raw-uint8 batches:

@@ -37,6 +37,7 @@ def test_main_train_mode(tmp_path, capsys):
     assert os.path.exists(os.path.join(tmp_path, "train", "metrics.jsonl"))
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1; runs in the full (unfiltered) suite
 @pytest.mark.heavy
 def test_main_train_and_eval_mode(tmp_path, capsys):
     main_mod.main(_args(
@@ -75,6 +76,7 @@ def test_main_eval_once_mode(tmp_path):
     assert "eval/best_precision" in recs[-1]
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1; runs in the full (unfiltered) suite
 @pytest.mark.heavy
 def test_replay_reference_smoke(tmp_path, monkeypatch):
     """tools/replay_reference.py --smoke runs the full recipe machinery
@@ -103,6 +105,7 @@ def test_main_mode_dispatch_fast():
         main_mod.main(["--preset", "smoke", "--set", "mode=bogus"])
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1; runs in the full (unfiltered) suite
 @pytest.mark.heavy
 def test_resume_config_mismatch_warns(tmp_path, caplog):
     """Resuming a checkpoint dir under a different training recipe warns

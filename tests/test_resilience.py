@@ -462,6 +462,7 @@ def test_run_train_deadline_preempts_commits_and_resumes(tmp_path):
     assert int(state.step) == steps[-1] + 5
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1; runs in the full suite and chaos_smoke.sh default mode
 def test_evaluator_skips_damaged_checkpoint(tmp_path):
     """A long-running polling evaluator must skip a checkpoint that gets
     damaged (or quarantined/reaped) between poll and restore, not die —
@@ -743,6 +744,7 @@ def _metric_events(tmp_path, sub="train"):
         return []
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1; runs in the full suite and chaos_smoke.sh default mode
 @pytest.mark.heavy
 def test_watchdog_kill_and_detect_survivor_exits_resumable(tmp_path):
     """THE acceptance scenario: SIGKILL one of two launch.py workers
@@ -808,6 +810,7 @@ def test_watchdog_kill_and_detect_survivor_exits_resumable(tmp_path):
     assert "watchdog_exit" in events
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1; runs in the full suite and chaos_smoke.sh default mode
 @pytest.mark.heavy
 def test_watchdog_normal_run_emits_heartbeat_and_straggler_rows(tmp_path):
     """A healthy 2-process run with the watchdog on: completes cleanly
@@ -843,6 +846,7 @@ def test_watchdog_normal_run_emits_heartbeat_and_straggler_rows(tmp_path):
 # kill-and-resume: SIGTERM a real main.py run mid-way (satellite)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1; runs in the full suite and chaos_smoke.sh default mode
 @pytest.mark.heavy
 def test_sigterm_kill_and_resume_exact_continuation(tmp_path):
     """SIGTERM a live trainer: it must exit with the resumable code (75)
