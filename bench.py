@@ -569,6 +569,46 @@ def bench_imagenet_norm(budget_left):
     return out
 
 
+def bench_serving(budget_left):
+    """The serving row (serve/; docs/serving.md): open-loop synthetic load
+    against the AOT-compiled batched inference server — p50/p99 request
+    latency and QPS per batch bucket, plus the startup compile cost. Uses
+    the smoke-scale ResNet so the row measures the SERVING machinery
+    (batcher coalescing, staging, bucket dispatch), comparable
+    round-over-round like the CIFAR headline."""
+    from distributed_resnet_tensorflow_tpu.serve.loadgen import run_open_loop
+    from distributed_resnet_tensorflow_tpu.serve.server import InferenceServer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    cfg = get_preset("smoke")
+    cfg.data.eval_batch_size = 64          # buckets: pad, 2x, ... 64
+    cfg.mesh.data = len(jax.devices())
+    cfg.serve.max_queue_delay_ms = 2.0
+    cfg.checkpoint.directory = os.path.join(
+        tempfile.gettempdir(), "drt_bench_serve_empty_ckpt")  # no ckpt:
+    # serving fresh-init params — the row times the serving path, not
+    # training; hot-swap cost is covered by tests/serve_smoke.sh
+    server = InferenceServer(cfg)
+    try:
+        server.start()
+        duration = min(8.0, max(3.0, budget_left() - 30))
+        load = run_open_loop(server, qps=50.0, duration_secs=duration,
+                             seed=0)
+    finally:
+        server.close()
+    rep = server.report()
+    return {
+        "offered_qps": load["offered_qps"],
+        "achieved_qps": rep["qps"],
+        "dropped": rep["dropped"],
+        "batches": rep["batches"],
+        "buckets": rep["buckets"],
+        "latency_by_bucket_ms": rep["latency_by_bucket_ms"],
+        "aot_warm_secs": rep["compile"]["warm_secs"],
+        "serve_time_compiles": rep["compile"]["serve_time_compiles"],
+    }
+
+
 def attention_grad_ms(attn_fn, q, k, v, iters=10, reps=3):
     """ms per fwd+bwd of ``attn_fn`` timed inside a lax.scan (the remote-
     tunnel dispatch floor would swamp per-call timing), fenced through a
@@ -657,6 +697,8 @@ def main():
                     ("vit_large_224",
                      lambda: bench_vit_large() if budget_left() > 150
                      else {"skipped": "over bench budget"}),
+                    # the serving row (serve/): p50/p99 + QPS per bucket
+                    ("serving", lambda: bench_serving(budget_left)),
                     ("imagenet_norm_contracts",
                      lambda: bench_imagenet_norm(budget_left))):
         if time.monotonic() - t0 > budget:

@@ -13,7 +13,9 @@ pushes shape/dtype-only values through:
     where shard_map in/out-spec errors, rank errors and divisibility
     errors surface at trace time; the pp×ep MoE ``_SpecError`` of
     tests/test_pipeline.py was located exactly this way),
-  * the eval step, and
+  * the eval step,
+  * the serve/predict step, once per batch bucket the inference server
+    would AOT-compile (serve/compile_cache.bucket_sizes), and
   * the checkpoint-restore contract (layout stamp + unique leaf paths).
 
 Zero data, zero compute, no compilation: the whole ``--all-presets``
@@ -222,6 +224,38 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
         except Exception as e:
             findings.append(_findings_from_exc("elab-eval-step", locus,
                                                "eval step", e))
+
+        # serve/predict step: every batch bucket the inference server
+        # would AOT-compile for this preset (serve/compile_cache.py —
+        # power-of-two buckets in multiples of the eval pad floor, the
+        # request dtype from serve_image_spec), traced abstractly so a
+        # bucket that can't trace is a gate finding here, not a serving
+        # replica that dies warming its compile cache
+        try:
+            from ..serve.compile_cache import bucket_sizes
+            from ..serve.server import serve_image_spec
+            pad_to = trainer.eval_pad_multiple()
+            img_shape, img_dtype = serve_image_spec(cfg)
+            # the SAME cap resolution the server uses (InferenceServer):
+            # a preset pinning serve.max_batch past eval_batch_size gets
+            # its real buckets elaborated, not the eval-sized ones
+            max_batch = cfg.serve.max_batch or cfg.data.eval_batch_size
+            buckets = bucket_sizes(max_batch, pad_to)
+        except Exception as e:
+            findings.append(_findings_from_exc("elab-serve-step", locus,
+                                               "serve step setup", e))
+            buckets = []
+        for bucket in buckets:
+            # per-bucket try: one gate run reports EVERY bad bucket, not
+            # whack-a-mole one per run
+            try:
+                sbatch = {"images": jax.ShapeDtypeStruct(
+                    (bucket,) + img_shape, img_dtype)}
+                jax.eval_shape(trainer._predict_step, state_shapes, sbatch)
+            except Exception as e:
+                findings.append(_findings_from_exc(
+                    "elab-serve-step", locus,
+                    f"serve step (bucket {bucket})", e))
 
     # restore contract: the layout stamp must compute, and every leaf path
     # must be unique (the checkpoint manifest is keyed by flattened path)

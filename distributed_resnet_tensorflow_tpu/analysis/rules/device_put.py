@@ -8,6 +8,13 @@ same module. A ``jax.device_put`` sprinkled anywhere else silently escapes
 transfer accounting, dtype coercion (``coerce_batch_dtypes``), and the
 single-issue audit — so any call outside ``parallel/sharding.py`` is a
 finding. Deliberate exceptions carry ``# shardcheck: ok(stray-device-put)``.
+
+This explicitly covers ``serve/``: the inference server's request path
+stages batches through the Trainer's put (CoalescedStager) and the hot-swap
+apply goes through ``put_to_sharding`` — a raw ``device_put`` there would
+also dodge the serving threading contract (the swap thread moves HOST trees
+only; all device placement happens on the dispatch thread or via the
+audited put paths — docs/serving.md). No new raw device_put sites.
 """
 from __future__ import annotations
 

@@ -1,1 +1,2 @@
-from .manager import CheckpointManager, wait_for_new_checkpoint  # noqa: F401
+from .manager import (CheckpointManager, poll_new_checkpoint,  # noqa: F401
+                      wait_for_new_checkpoint)

@@ -518,21 +518,40 @@ class CheckpointManager:
             self._executor.shutdown(wait=True)
 
 
+def poll_new_checkpoint(directory: str, last_seen: Optional[int]
+                        ) -> Optional[Tuple[int, str, str]]:
+    """Non-blocking single poll: the newest COMMITTED checkpoint newer than
+    ``last_seen``, or None. Returns ``(step, step_dir, manifest_digest)`` —
+    the digest (SHA-256 of MANIFEST.json, "" for pre-protocol checkpoints)
+    identifies the checkpoint's exact content, so consumers that act on a
+    new step (the serving hot-swap thread, serve/swap.py) can report WHICH
+    state went live, and callers own their sleep policy instead of
+    busy-sleeping a fixed interval inside this module (the evaluator uses
+    jittered backoff, the swap thread a jittered fixed cadence).
+
+    Only commit-renamed step dirs are visible (resilience/manifest.py), so
+    a poller can never pick up a checkpoint mid-write."""
+    from ..resilience.manifest import manifest_digest
+    steps = committed_steps(directory)
+    newest = steps[-1] if steps else None
+    if newest is None or (last_seen is not None and newest <= last_seen):
+        return None
+    step_dir = os.path.join(directory, str(newest))
+    return newest, step_dir, manifest_digest(step_dir)
+
+
 def wait_for_new_checkpoint(directory: str, last_seen: Optional[int],
                             timeout_secs: float = 0.0,
                             poll_secs: float = 60.0) -> Optional[int]:
     """Block until a COMMITTED checkpoint newer than ``last_seen`` appears —
-    the evaluator's polling primitive (reference resnet_cifar_eval.py:99-141
-    polled get_checkpoint_state + slept 60 s). timeout 0 = single poll.
-
-    Only commit-renamed step dirs are visible (resilience/manifest.py), so
-    the evaluator can never pick up a checkpoint mid-write."""
+    the fixed-interval polling primitive (reference resnet_cifar_eval.py:
+    99-141 polled get_checkpoint_state + slept 60 s). timeout 0 = single
+    poll. Thin blocking wrapper over ``poll_new_checkpoint``."""
     deadline = time.monotonic() + timeout_secs if timeout_secs else None
     while True:
-        steps = committed_steps(directory)
-        newest = steps[-1] if steps else None
-        if newest is not None and (last_seen is None or newest > last_seen):
-            return newest
+        hit = poll_new_checkpoint(directory, last_seen)
+        if hit is not None:
+            return hit[0]
         if deadline is None or time.monotonic() >= deadline:
             return None
         time.sleep(min(poll_secs, max(0.0, deadline - time.monotonic())))

@@ -15,7 +15,7 @@ from __future__ import annotations
 import logging
 from typing import Dict, Iterator, Optional
 
-from .checkpoint import CheckpointManager, wait_for_new_checkpoint
+from .checkpoint import CheckpointManager, poll_new_checkpoint
 from .checkpoint.manager import CheckpointCorrupt
 from .train.loop import Trainer
 from .utils.metrics import MetricsWriter
@@ -103,6 +103,32 @@ class Evaluator:
                  result["loss"])
         return result
 
+    def _wait_new_checkpoint(self, timeout_secs: float) -> Optional[int]:
+        """Jittered-backoff poll over the non-blocking
+        ``poll_new_checkpoint``: the first re-check comes ~1 s after a miss
+        and the interval doubles up to ``eval.poll_interval_secs`` (±50%
+        jitter per sleep). Replaces the fixed-interval busy-sleep —
+        checkpoints published seconds apart are picked up in seconds
+        instead of a full poll interval later, a drought backs off to the
+        configured cadence, and many evaluators/serving replicas sharing a
+        checkpoint filesystem don't stat it in lockstep. ``timeout_secs=0``
+        keeps the single-poll contract."""
+        import random
+        import time
+        cap = max(0.1, self.cfg.eval.poll_interval_secs)
+        delay = min(1.0, cap)
+        deadline = time.monotonic() + timeout_secs if timeout_secs else None
+        rng = random.Random()
+        while True:
+            hit = poll_new_checkpoint(self.manager.directory, self.last_step)
+            if hit is not None:
+                return hit[0]
+            if deadline is None or time.monotonic() >= deadline:
+                return None
+            time.sleep(min(delay * rng.uniform(0.5, 1.5),
+                           max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2, cap)
+
     def run(self, max_evals: Optional[int] = None,
             timeout_secs: float = 0.0) -> Dict[str, float]:
         """Poll-evaluate loop. ``eval_once`` (reference --eval_once flag) or
@@ -119,10 +145,7 @@ class Evaluator:
         n = 0
         max_fail = self.cfg.eval.max_consecutive_failures
         while True:
-            step = wait_for_new_checkpoint(
-                self.manager.directory, self.last_step,
-                timeout_secs=timeout_secs,
-                poll_secs=self.cfg.eval.poll_interval_secs)
+            step = self._wait_new_checkpoint(timeout_secs)
             if step is None:
                 log.info("no new checkpoint; evaluator exiting")
                 return result

@@ -479,6 +479,86 @@ def run_eval(cfg: ExperimentConfig, max_evals: Optional[int] = None,
             writer.close()  # flush buffered events (see run_train)
 
 
+def run_serve(cfg: ExperimentConfig):
+    """Inference server mode (serve/; docs/serving.md): restore the newest
+    committed checkpoint, AOT-compile every batch bucket, serve dynamic
+    request batches, hot-swap newer checkpoints with zero downtime.
+
+    With ``serve.load_qps > 0`` the open-loop synthetic load generator
+    drives the server for ``serve.load_duration_secs``, then a JSON report
+    (p50/p99 per bucket, QPS, swaps, dropped-request count) prints and the
+    process exits — scripts/serve_smoke.sh and capacity planning. With
+    ``load_qps = 0`` the server runs until SIGINT/SIGTERM (requests come
+    from in-process ``InferenceServer.submit`` embedders)."""
+    import json as _json
+    import time as _time
+
+    from .serve.loadgen import run_open_loop, synthetic_requests
+    from .serve.server import InferenceServer
+
+    serve_dir = os.path.join(cfg.log_root, "serve")
+    writer = MetricsWriter(serve_dir) if is_chief() else None
+    server = InferenceServer(cfg, writer=writer)
+    load = None
+    try:
+        server.start()
+        # orchestration marker (scripts/serve_smoke.sh waits on it before
+        # publishing checkpoints: a commit landing before the initial
+        # restore would be picked up at startup, not hot-swapped)
+        with open(os.path.join(serve_dir, "READY"), "w") as f:
+            f.write(str(os.getpid()))
+        if cfg.serve.load_qps > 0:
+            load = run_open_loop(server, cfg.serve.load_qps,
+                                 cfg.serve.load_duration_secs,
+                                 seed=cfg.serve.load_seed)
+            if cfg.serve.wait_for_swap_secs > 0 and server.swaps == 0:
+                # smoke determinism: a training publisher is racing us —
+                # keep serving (idle) until its commit lands or we time out
+                deadline = _time.monotonic() + cfg.serve.wait_for_swap_secs
+                while server.swaps == 0 and _time.monotonic() < deadline:
+                    _time.sleep(0.25)
+            # post-load probe: a few requests AFTER any swap prove the
+            # server still answers (the smoke's "zero downtime" witness)
+            probes = [server.submit(im) for im in synthetic_requests(
+                server.image_shape, server.image_dtype, pool=4,
+                seed=cfg.serve.load_seed + 1)]
+            for f in probes:
+                f.result(timeout=120.0)
+        else:
+            # park until SIGTERM/SIGINT — HANDLED, not defaulted: the
+            # default SIGTERM action would kill the process mid-request
+            # (no drain, no close(), unresolved futures), and systemd/k8s
+            # stop with SIGTERM. The finally below then drains: every
+            # accepted request is answered before exit.
+            import signal
+            import threading
+            stop = threading.Event()
+            prev = {}
+            if threading.current_thread() is threading.main_thread():
+                for sig in (signal.SIGTERM, signal.SIGINT):
+                    prev[sig] = signal.signal(
+                        sig, lambda *_args: stop.set())
+            log.info("serving (no load generator); SIGTERM/Ctrl-C stops "
+                     "with a full drain")
+            try:
+                while not stop.wait(1.0):
+                    pass
+            except KeyboardInterrupt:
+                pass
+            finally:
+                for sig, handler in prev.items():
+                    signal.signal(sig, handler)
+    finally:
+        server.close()  # drains: every accepted request is answered
+        if writer is not None:
+            writer.close()
+    report = server.report()
+    if load is not None:
+        report["load"] = load
+    print(_json.dumps(report))
+    return report
+
+
 def run_train_and_eval(cfg: ExperimentConfig):
     """In-process alternation: train eval_every_steps, then eval (the
     reference instead dedicated a whole node to the evaluator,
@@ -599,11 +679,20 @@ def main(argv=None):
         # virtual CPU mesh — no cluster, no data (docs/static_analysis.md)
         from .analysis.cli import main_check
         sys.exit(main_check(argv[1:]))
+    serve_cmd = False
+    if argv and argv[0] == "serve":
+        # inference server (serve/, docs/serving.md): same flags as the
+        # trainer — `main.py serve --preset X --set serve.load_qps=...`
+        # is sugar for `--set mode=serve`
+        serve_cmd = True
+        argv = argv[1:]
     # honor JAX_PLATFORMS even when a site plugin (e.g. this environment's
     # axon sitecustomize) overrode it via jax.config at interpreter start
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     cfg = parse_args(argv)
+    if serve_cmd:
+        cfg.mode = "serve"
     if cfg.analysis.dispatch_sanitizer:
         # opt-in cross-thread dispatch guard (analysis/dispatch_sanitizer):
         # a second dispatching thread raises at its call site instead of
@@ -621,6 +710,8 @@ def main(argv=None):
             run_eval(cfg, timeout_secs=0.0 if cfg.eval.eval_once else 86400.0)
         elif cfg.mode == "train_and_eval":
             run_train_and_eval(cfg)
+        elif cfg.mode == "serve":
+            run_serve(cfg)
         else:
             raise ValueError(f"unknown mode {cfg.mode!r}")
     except Preempted as p:

@@ -238,6 +238,32 @@ def pad_batch_to_multiple(batch: dict, multiple: int) -> dict:
     return out
 
 
+def pad_batch_to_bucket(batch: dict, bucket: int) -> dict:
+    """Pad the leading dim up to EXACTLY ``bucket`` rows — the serving
+    batcher's padding (serve/batcher.py): a partial group of in-flight
+    requests lands in its power-of-two bucket so every bucket size maps to
+    ONE AOT-compiled program. Same mask semantics as
+    ``pad_batch_to_multiple`` (padded rows carry mask 0); buckets are sized
+    in multiples of ``Trainer.eval_pad_multiple`` so the padded batch also
+    divides over the batch shards (× pipeline microbatches)."""
+    b = next(iter(batch.values())).shape[0]
+    if b > bucket:
+        raise ValueError(f"batch of {b} rows does not fit bucket {bucket}")
+    pad = bucket - b
+    out = {}
+    for k, v in batch.items():
+        if k == "mask":
+            continue
+        pad_width = ((0, pad),) + ((0, 0),) * (np.asarray(v).ndim - 1)
+        out[k] = np.pad(np.asarray(v), pad_width)
+    mask = batch.get("mask")
+    if mask is None:
+        mask = np.ones((b,), np.float32)
+    out["mask"] = np.concatenate([np.asarray(mask, np.float32),
+                                  np.zeros((pad,), np.float32)])
+    return out
+
+
 def shard_stacked_batch(batch: Any, mesh: Mesh) -> Any:
     """Like shard_batch but for K-step stacked batches (K, B, ...): the K
     axis is unsharded (scan iterates it), B splits over the batch axes."""

@@ -149,6 +149,21 @@ def manifest_status(step_dir: str) -> Tuple[str, str]:
     return "ok", ""
 
 
+def manifest_digest(step_dir: str) -> str:
+    """SHA-256 of the committed step's ``MANIFEST.json`` bytes — a compact
+    identity for the checkpoint's CONTENT (the manifest lists every payload
+    file with its size and hash, so two commits with identical payloads get
+    identical digests). Consumers: the serving hot-swap path reports which
+    exact checkpoint is live (``poll_new_checkpoint``, serve/swap.py).
+    Empty string for legacy/pre-protocol checkpoints with no manifest."""
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return ""
+
+
 def committed_steps(directory: str) -> List[int]:
     """Steps with a COMMITTED checkpoint dir (bare-numeric name), sorted
     ascending. Staging dirs, orbax tmp dirs (``<step>.orbax-checkpoint-

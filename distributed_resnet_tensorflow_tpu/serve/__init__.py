@@ -1,0 +1,14 @@
+"""serve/ — AOT-compiled batched inference with hot checkpoint swap.
+
+The serving path the ROADMAP north-star requires and the reference never
+had (its pipeline ended at the checkpoint): ``main.py serve`` turns a
+training run's committed checkpoints into live low-latency capacity.
+docs/serving.md is the manual; tests/test_serve.py and
+scripts/serve_smoke.sh exercise it on CPU.
+"""
+from .batcher import DynamicBatcher  # noqa: F401
+from .compile_cache import (ServeCompileCache, bucket_sizes,  # noqa: F401
+                            pick_bucket)
+from .loadgen import run_open_loop, synthetic_requests  # noqa: F401
+from .server import InferenceServer, serve_image_spec  # noqa: F401
+from .swap import CheckpointSwapper, PendingSwap  # noqa: F401

@@ -1,0 +1,216 @@
+"""Dynamic request batcher: coalesce in-flight requests into bucket batches.
+
+Requests arrive one example at a time from any number of submitter threads
+(``submit`` returns a ``concurrent.futures.Future``); ONE dispatch thread
+drains the queue, holds the first request up to ``max_queue_delay_ms`` to
+coalesce late arrivals into a bigger bucket, pads the group into its
+power-of-two bucket (``parallel/sharding.pad_batch_to_bucket`` semantics)
+and runs the caller-supplied ``dispatch_fn`` — which stages the batch
+through the Trainer's put path and executes the AOT-compiled program.
+
+Threading contract (the PR 2 constraint, docs/input_pipeline.md): every
+multi-device XLA execution of the serving process — the staged-batch
+unpack AND the compiled predict — launches from THIS one dispatch thread.
+Submitters only enqueue numpy; the swap thread only reads files and hands
+host trees over (serve/swap.py). ``boundary_hook`` fires on the dispatch
+thread between batches (and when idle) — the server applies pending
+checkpoint swaps there, so a swap can never interleave with an in-flight
+batch: requests already dispatched complete on the old params, the next
+batch sees the new ones. The dispatch sanitizer
+(``--set analysis.dispatch_sanitizer=true``) enforces all of this at
+runtime; scripts/serve_smoke.sh runs with it armed.
+
+Zero dropped requests: ``close()`` stops intake first (late ``submit``
+raises), then drains everything already queued before the thread exits —
+a request accepted is a request answered (or failed loudly via its
+future's exception).
+"""
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class _Request:
+    __slots__ = ("image", "future", "t_submit")
+
+    def __init__(self, image):
+        self.image = image
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Single-consumer dynamic batcher over power-of-two buckets.
+
+    ``dispatch_fn(images, requests)`` runs on the dispatch thread with
+    ``images`` already padded to its bucket; it must resolve every
+    request's future (the server sets ``(logits_row, step)`` results).
+    ``boundary_hook()`` runs on the dispatch thread between batches/idle
+    polls (see module docstring).
+    """
+
+    def __init__(self, buckets: Sequence[int],
+                 dispatch_fn: Callable[[np.ndarray, List[_Request]], None],
+                 image_shape, image_dtype,
+                 max_queue_delay_ms: float = 5.0,
+                 boundary_hook: Optional[Callable[[], None]] = None):
+        from .compile_cache import pick_bucket
+        self._pick_bucket = pick_bucket
+        self.buckets = sorted(int(b) for b in buckets)
+        self.max_batch = self.buckets[-1]
+        self._dispatch_fn = dispatch_fn
+        self._image_shape = tuple(image_shape)
+        self._image_dtype = np.dtype(image_dtype)
+        self.max_queue_delay_ms = float(max_queue_delay_ms)
+        self._boundary_hook = boundary_hook
+        self._q: queue_mod.Queue = queue_mod.Queue()
+        self._stop = threading.Event()
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # counters (dispatch-thread writes, any-thread reads)
+        self.requests_in = 0
+        self.batches = 0
+        self.errors = 0
+        self.failed_requests = 0  # answered via future.set_exception
+        self._in_lock = threading.Lock()
+
+    # -- submitter side ----------------------------------------------------
+    def submit(self, image) -> Future:
+        """Enqueue one example; returns the request's Future. Any thread."""
+        if self._closed.is_set():
+            raise RuntimeError("batcher is closed; request rejected")
+        arr = np.asarray(image)
+        if arr.dtype != self._image_dtype:
+            # strict, no silent cast: float32-[0,1] crops coerced to a
+            # uint8 spec would truncate to black, uint8 to a float32 spec
+            # would serve unstandardized pixels — both answer confidently
+            # with garbage. Requests must arrive prepped exactly as the
+            # eval input pipeline delivers them (serve_image_spec).
+            raise ValueError(
+                f"request image dtype {arr.dtype} != serving spec "
+                f"{self._image_dtype}")
+        if arr.shape != self._image_shape:
+            raise ValueError(
+                f"request image shape {arr.shape} != serving spec "
+                f"{self._image_shape}")
+        req = _Request(arr)
+        with self._in_lock:
+            # the closed-check and the enqueue share one lock with
+            # close(): once close() flips _closed under this lock, no
+            # submit can slip a request past the drain — accepted means
+            # answered, rejected means this raise, nothing in between
+            if self._closed.is_set():
+                raise RuntimeError("batcher is closed; request rejected")
+            self.requests_in += 1
+            self._q.put(req)
+        return req.future
+
+    # -- dispatch side -----------------------------------------------------
+    def _collect(self, block_secs: float) -> Optional[List[_Request]]:
+        """One group: the first request (waiting up to ``block_secs``), then
+        late arrivals up to ``max_queue_delay_ms`` or the largest bucket."""
+        try:
+            first = self._q.get(timeout=block_secs) if block_secs > 0 \
+                else self._q.get_nowait()
+        except queue_mod.Empty:
+            return None
+        group = [first]
+        deadline = time.perf_counter() + self.max_queue_delay_ms / 1000.0
+        while len(group) < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            try:
+                group.append(self._q.get(timeout=max(0.0, remaining))
+                             if remaining > 0 else self._q.get_nowait())
+            except queue_mod.Empty:
+                if remaining <= 0:
+                    break
+                continue
+        return group
+
+    def _dispatch(self, group: List[_Request]) -> None:
+        from ..parallel.sharding import pad_batch_to_bucket
+        bucket = self._pick_bucket(self.buckets, len(group))
+        # THE bucket-padding implementation (parallel/sharding.py) — one
+        # home for the semantics. The mask is dropped: the predict step
+        # takes images only, and the padded rows' logits are dead weight
+        # nobody slices out (rows are batch-independent under train=False)
+        stacked = np.stack([req.image for req in group])
+        images = pad_batch_to_bucket({"images": stacked}, bucket)["images"]
+        try:
+            self._dispatch_fn(images, group)
+        except BaseException as e:  # noqa: BLE001 — resolve futures, keep serving
+            self.errors += 1
+            log.exception("serve dispatch failed (bucket %d, n=%d)",
+                          bucket, len(group))
+            for req in group:
+                if not req.future.done():
+                    req.future.set_exception(e)
+                    self.failed_requests += 1
+        self.batches += 1
+
+    def _drain(self) -> None:
+        """Serve everything already queued (no delay wait — the queue's
+        current content is the whole remaining load). Intake must be
+        sealed before calling."""
+        while True:
+            group = self._collect(block_secs=0.0)
+            if group is None:
+                return
+            self._dispatch(group)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            group = self._collect(block_secs=0.05)
+            if group is not None:
+                self._dispatch(group)
+            if self._boundary_hook is not None:
+                self._boundary_hook()
+        # drain: everything accepted before close() gets served
+        self._drain()
+
+    def start(self) -> "DynamicBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="drt-serve-dispatch")
+            self._thread.start()
+        return self
+
+    def service_once(self, block_secs: float = 0.0) -> int:
+        """Synchronous single service turn on the CALLING thread — tests
+        and thread-less embedding: collect one group (if any), dispatch it,
+        run the boundary hook. Returns requests served. Must not be mixed
+        with a started dispatch thread."""
+        if self._thread is not None:
+            raise RuntimeError("service_once with a live dispatch thread "
+                               "would violate single-thread dispatch")
+        group = self._collect(block_secs=block_secs)
+        if group is not None:
+            self._dispatch(group)
+        if self._boundary_hook is not None:
+            self._boundary_hook()
+        return 0 if group is None else len(group)
+
+    def close(self) -> None:
+        """Stop intake, drain the queue, join the dispatch thread."""
+        with self._in_lock:  # see submit(): after this, intake is sealed
+            self._closed.set()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            if self._thread.is_alive():  # never silent (no-silent-caps rule)
+                log.error("serve dispatch thread failed to drain in 60s")
+            self._thread = None
+        else:
+            # thread-less (service_once) mode: the caller IS the dispatch
+            # thread — drain here, or requests accepted before close would
+            # seal in with futures that never resolve
+            self._drain()

@@ -1,0 +1,140 @@
+"""AOT compile cache: every serve bucket compiled BEFORE the first request.
+
+The serving forward (``train/loop.make_predict_step`` — the exact step
+``analysis/elaborate.py`` traces per preset × bucket) is lowered and
+compiled ahead of time for each batch bucket at server startup, with the
+same state shardings the Trainer uses and the batch arriving via
+``data_sharding`` — so the request path NEVER pays XLA: a cold server's
+first request runs a cached executable, and a latency SLO can't be blown
+by a compile hiding behind an unlucky batch size.
+
+Buckets are powers of two (in multiples of ``Trainer.eval_pad_multiple``,
+so every padded batch divides over the batch shards × pipeline
+microbatches) up to the request-batch cap — a handful of programs total,
+compiled once, keyed by (bucket, image shape, dtype).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def bucket_sizes(max_batch: int, multiple: int = 1) -> List[int]:
+    """Power-of-two batch buckets: ``multiple``, 2×, 4×, ... capped (and
+    topped) by ``max_batch`` rounded up to a multiple of ``multiple``.
+
+    ``multiple`` is the pad floor (``Trainer.eval_pad_multiple`` — batch
+    shards × pipeline microbatches): every bucket must divide over the
+    mesh's batch axes or the dispatch itself would be ill-specced. The cap
+    bucket keeps the configured max batch reachable even when it is not a
+    power of two (e.g. eval_batch_size=100 over 8 shards → buckets
+    8, 16, 32, 64, 104)."""
+    if max_batch <= 0:
+        raise ValueError(f"max_batch must be positive, got {max_batch}")
+    multiple = max(1, multiple)
+    cap = -(-max_batch // multiple) * multiple  # round UP to the pad floor
+    out = []
+    b = multiple
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return out
+
+
+def pick_bucket(buckets: List[int], n: int) -> int:
+    """Smallest bucket that fits ``n`` requests (buckets sorted ascending)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} requests exceed the largest bucket {buckets[-1]}")
+
+
+class ServeCompileCache:
+    """Per-(bucket, image spec) AOT-compiled serving executables.
+
+    ``warm()`` lowers+compiles every bucket up front (startup cost, logged
+    per bucket); a ``get()`` miss after warmup still compiles — correctness
+    over refusal — but counts it in ``serve_time_compiles`` and warns,
+    because a request paying a compile means the warmup spec and the live
+    traffic disagree (wrong dtype/shape) and the SLO story is broken.
+
+    Thread-safety: ``get``/``warm`` may be called from any thread (compile
+    is pure — no device execution happens here); EXECUTING the returned
+    compiled fn is the caller's single-dispatch-thread responsibility
+    (serve/batcher.py; docs/input_pipeline.md threading model).
+    """
+
+    def __init__(self, trainer):
+        from ..parallel.mesh import data_sharding
+        from ..train.state import state_shardings
+        self.trainer = trainer
+        self._state_abstract = jax.eval_shape(lambda s: s, trainer.state)
+        self._st_sh = state_shardings(self._state_abstract, trainer.mesh)
+        self._b_sh = data_sharding(trainer.mesh)
+        self._compiled: Dict[Tuple, object] = {}
+        self._lock = threading.Lock()
+        self.warm_secs = 0.0
+        self.serve_time_compiles = 0
+
+    def _key(self, bucket: int, image_shape: Tuple[int, ...],
+             dtype) -> Tuple:
+        return (int(bucket), tuple(image_shape), np.dtype(dtype).str)
+
+    def _compile(self, bucket: int, image_shape: Tuple[int, ...], dtype):
+        batch_abstract = {"images": jax.ShapeDtypeStruct(
+            (bucket,) + tuple(image_shape), np.dtype(dtype))}
+        jitted = jax.jit(self.trainer._predict_step,
+                         in_shardings=(self._st_sh, {"images": self._b_sh}))
+        return jitted.lower(self._state_abstract, batch_abstract).compile()
+
+    def get(self, bucket: int, image_shape: Tuple[int, ...], dtype,
+            warm: bool = False):
+        key = self._key(bucket, image_shape, dtype)
+        with self._lock:
+            hit = self._compiled.get(key)
+        if hit is not None:
+            return hit
+        t0 = time.perf_counter()
+        compiled = self._compile(bucket, image_shape, dtype)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            # a concurrent compile of the same key may have won the race;
+            # keep the first so executions reuse one executable
+            hit = self._compiled.setdefault(key, compiled)
+            if warm:
+                self.warm_secs += dt
+            elif hit is compiled:
+                self.serve_time_compiles += 1
+        if warm:
+            log.info("serve compile cache: bucket %d %s %s compiled in "
+                     "%.2fs", bucket, tuple(image_shape),
+                     np.dtype(dtype).name, dt)
+        elif hit is compiled:
+            log.warning(
+                "serve compile cache MISS at request time: bucket %d %s %s "
+                "compiled in %.2fs on the request path — the warmup spec "
+                "and live traffic disagree (serve.warm_buckets / request "
+                "dtype)", bucket, tuple(image_shape), np.dtype(dtype).name,
+                dt)
+        return hit
+
+    def warm(self, buckets: List[int], image_shape: Tuple[int, ...],
+             dtype) -> float:
+        """Compile every bucket now; returns total compile seconds."""
+        t0 = time.perf_counter()
+        for b in buckets:
+            self.get(b, image_shape, dtype, warm=True)
+        return time.perf_counter() - t0
+
+    @property
+    def compiled_buckets(self) -> List[int]:
+        with self._lock:
+            return sorted({k[0] for k in self._compiled})

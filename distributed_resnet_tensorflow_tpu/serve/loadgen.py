@@ -1,0 +1,75 @@
+"""Open-loop synthetic load generator for the inference server.
+
+OPEN loop: arrivals are scheduled on a fixed clock (request i at
+``t0 + i/qps``) regardless of completions — the load a real user
+population offers, and the one that exposes queueing collapse. A
+closed-loop driver (wait for each response before sending the next) would
+self-throttle exactly when the server is slowest and report flattering
+latency (coordinated omission). The generator never blocks on a Future
+until the offered load is fully submitted; per-request latency is recorded
+by the server at result time, so a late response is charged its full
+queue + service time.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from concurrent.futures import wait as futures_wait
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def synthetic_requests(image_shape, dtype, pool: int = 32, seed: int = 0):
+    """A small pool of random request images, cycled by the generator (the
+    per-request content doesn't affect timing; generating fresh images at
+    high QPS would bottleneck the GENERATOR, not measure the server)."""
+    rng = np.random.RandomState(seed)
+    dtype = np.dtype(dtype)
+    if dtype == np.uint8:
+        return [rng.randint(0, 256, image_shape, np.uint8)
+                for _ in range(pool)]
+    return [rng.randn(*image_shape).astype(dtype) for _ in range(pool)]
+
+
+def run_open_loop(server, qps: float, duration_secs: float,
+                  seed: int = 0, timeout_secs: Optional[float] = None
+                  ) -> dict:
+    """Offer ``qps`` requests/sec for ``duration_secs``, then wait for every
+    outstanding Future. Returns offered/completed/failed/late counts and
+    the achieved submit rate; latency percentiles live in
+    ``server.report()`` (recorded server-side per request)."""
+    n = max(1, int(qps * duration_secs))
+    pool = synthetic_requests(server.image_shape, server.image_dtype,
+                              seed=seed)
+    futures = []
+    late = 0
+    t0 = time.perf_counter()
+    for i in range(n):
+        target = t0 + i / qps
+        now = time.perf_counter()
+        if now < target:
+            time.sleep(target - now)
+        elif now - target > 0.5:
+            late += 1  # generator itself fell behind the open-loop clock
+        futures.append(server.submit(pool[i % len(pool)]))
+    submit_wall = time.perf_counter() - t0
+    done, not_done = futures_wait(
+        futures, timeout=timeout_secs if timeout_secs is not None
+        else max(60.0, duration_secs))
+    failed = sum(1 for f in done if f.exception() is not None)
+    if not_done:
+        log.error("open-loop load: %d request(s) unresolved at timeout",
+                  len(not_done))
+    return {
+        "offered": n,
+        "completed": len(done) - failed,
+        "failed": failed,
+        "unresolved": len(not_done),
+        "late_submits": late,
+        "offered_qps": round(qps, 1),
+        "achieved_submit_qps": round(n / max(submit_wall, 1e-9), 1),
+        "wall_secs": round(submit_wall, 2),
+    }

@@ -1,0 +1,199 @@
+"""Hot checkpoint swap: new params restored OFF the request path.
+
+A background thread polls the checkpoint directory with the committed-
+manifest machinery (``resilience.manifest.committed_steps`` — the same
+primitive behind ``checkpoint.manager.poll_new_checkpoint``; only
+commit-renamed steps are ever visible), walks new steps newest-first past
+damaged ones, verifies the manifest, and deserializes the payload into
+HOST numpy trees. Nothing here touches the
+device: the restored tree is parked as a *pending swap* that the serving
+dispatch thread picks up at a batch boundary (serve/batcher.py
+``boundary_hook``) and applies atomically — in-flight requests complete on
+the old params, the next batch sees the new checkpoint, zero requests
+dropped, zero downtime.
+
+A torn/damaged checkpoint (manifest verification failure, deserialization
+error) is REJECTED without disturbing the serving params: the swap thread
+logs it, records the rejection, advances past the bad step (so it doesn't
+spin on it — exactly the evaluator's skip contract, docs/resilience.md)
+and keeps polling for the next good commit.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..resilience.manifest import (committed_steps, manifest_digest,
+                                   manifest_status)
+
+log = logging.getLogger(__name__)
+
+_PAYLOAD_DIRS = ("data", "default")  # manager.py layout, then legacy orbax
+
+
+def _payload_path(step_dir: str) -> str:
+    for name in _PAYLOAD_DIRS:
+        cand = os.path.join(step_dir, name)
+        if os.path.isdir(cand):
+            return cand
+    return step_dir  # bare orbax tree (oldest layout)
+
+
+class PendingSwap:
+    """A verified checkpoint restored to host memory, ready to apply."""
+
+    __slots__ = ("step", "digest", "params", "batch_stats", "restore_ms")
+
+    def __init__(self, step: int, digest: str, params, batch_stats,
+                 restore_ms: float):
+        self.step = step
+        self.digest = digest
+        self.params = params
+        self.batch_stats = batch_stats
+        self.restore_ms = restore_ms
+
+
+class CheckpointSwapper:
+    """Background poll → verify → host-restore → pending-swap handoff.
+
+    ``poll_once()`` is the whole state machine (also called directly by
+    tests and by the server's startup restore); ``start()`` runs it on a
+    daemon thread at a jittered ``poll_secs`` cadence (±50% — many serving
+    replicas sharing a checkpoint FS must not poll in lockstep).
+    ``on_reject(step, reason)`` fires for damaged checkpoints (the server
+    emits the rejected ``serve_swap`` metrics row there).
+    """
+
+    def __init__(self, directory: str, poll_secs: float = 5.0,
+                 on_reject: Optional[Callable[[int, str], None]] = None,
+                 seed: int = 0):
+        import orbax.checkpoint as ocp
+        self.directory = directory
+        self.poll_secs = max(0.1, poll_secs)
+        self.last_seen: Optional[int] = None
+        self.rejected = 0
+        self._on_reject = on_reject
+        self._ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+        self._pending: Optional[PendingSwap] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._rng = random.Random(seed)
+
+    # -- one poll turn (no device work; safe on any thread) ----------------
+    def poll_once(self) -> Optional[PendingSwap]:
+        """Walk the committed steps NEWER than ``last_seen`` newest-first
+        until one verifies and loads — the manager.restore fallback
+        contract (docs/resilience.md) applied to serving: a torn newest
+        commit must not hide a strictly newer GOOD one (trainer committed
+        4 then 6 between polls, 6 tore → serve 4, not stale params
+        forever). ``last_seen`` advances to the newest committed step
+        regardless, so bad steps are skipped, never re-verified every
+        poll."""
+        steps = committed_steps(self.directory)
+        if self.last_seen is not None:
+            steps = [s for s in steps if s > self.last_seen]
+        if not steps:
+            return None
+        self.last_seen = steps[-1]
+        for step in reversed(steps):
+            step_dir = os.path.join(self.directory, str(step))
+            pending = self._load_step(step, step_dir,
+                                      manifest_digest(step_dir))
+            if pending is not None:
+                return pending
+        return None
+
+    def restore_newest_valid(self) -> Optional[PendingSwap]:
+        """STARTUP restore: the newest committed checkpoint that verifies,
+        falling back past damaged ones — a restarting replica must never
+        serve fresh-init params while a good checkpoint exists. Same walk
+        as ``poll_once`` with nothing seen yet."""
+        return self.poll_once()
+
+    def _load_step(self, step: int, step_dir: str,
+                   digest: str) -> Optional[PendingSwap]:
+        """Verify + host-restore one committed step; parks (and returns)
+        the PendingSwap, or records the rejection and returns None."""
+        t0 = time.perf_counter()
+        status, detail = manifest_status(step_dir)
+        if status == "bad":
+            return self._reject(step, f"manifest verification failed: "
+                                      f"{detail}")
+        if status == "legacy":
+            log.info("serve swap: checkpoint step %d has no manifest "
+                     "(pre-protocol) — restoring unverified", step)
+        try:
+            # restore to HOST (no abstract target -> numpy leaves): the
+            # dispatch thread owns all device placement (module docstring)
+            tree = self._ckptr.restore(_payload_path(step_dir))
+            host = {
+                "step": int(np.asarray(tree["step"])),
+                "params": tree["params"],
+                "batch_stats": tree["batch_stats"],
+            }
+        except Exception as e:  # torn pre-manifest payloads land here
+            return self._reject(step, f"deserialization failed: "
+                                      f"{type(e).__name__}: {e}")
+        pending = PendingSwap(
+            host["step"], digest, host["params"], host["batch_stats"],
+            restore_ms=(time.perf_counter() - t0) * 1000.0)
+        with self._lock:
+            # newest wins: an unapplied older pending swap is superseded —
+            # serving an intermediate checkpoint late would move the
+            # replica BACKWARD relative to the directory
+            self._pending = pending
+        log.info("serve swap: checkpoint step %d restored off-path in "
+                 "%.0fms (digest %s)", pending.step, pending.restore_ms,
+                 (digest or "none")[:12])
+        return pending
+
+    def _reject(self, step: int, reason: str) -> None:
+        self.rejected += 1
+        log.warning("serve swap: REJECTED checkpoint step %d — %s; serving "
+                    "params untouched, polling for the next commit",
+                    step, reason)
+        if self._on_reject is not None:
+            self._on_reject(step, reason)
+        return None
+
+    def take_pending(self) -> Optional[PendingSwap]:
+        """Claim the pending swap (dispatch thread, at a batch boundary)."""
+        with self._lock:
+            pending, self._pending = self._pending, None
+        return pending
+
+    @property
+    def has_pending(self) -> bool:
+        with self._lock:
+            return self._pending is not None
+
+    # -- background thread -------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                # a transient FS error must not kill the swap thread — the
+                # server would silently stop tracking training forever
+                log.exception("serve swap poll failed; retrying")
+            self._stop.wait(self.poll_secs * self._rng.uniform(0.5, 1.5))
+
+    def start(self) -> "CheckpointSwapper":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="drt-serve-swap")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -229,6 +229,30 @@ def make_eval_step(prep_fn: Optional[Callable] = None):
     return eval_step
 
 
+def make_predict_step(prep_fn: Optional[Callable] = None):
+    """predict_step(state, batch) -> float32 logits — the SERVING forward
+    (serve/): eval's forward pass without the metric reduction, so the
+    dynamic batcher can slice per-request rows out of one bucket dispatch.
+    Padding rows (serve buckets) simply produce logits nobody reads; with
+    ``train=False`` BN uses running stats, so each row's logits are
+    independent of its batchmates — bucket-batched serving is numerically
+    the unbatched eval forward.
+
+    ``prep_fn`` is the SAME device-side input prep the eval step uses
+    (make_eval_step) — the serve path must agree with eval about who
+    standardizes or requests would be double-/un-normalized."""
+
+    def predict_step(state: TrainState, batch):
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        images = batch["images"]
+        if prep_fn is not None:
+            images = prep_fn(images)
+        logits = state.apply_fn(variables, images, train=False)
+        return logits.astype(jnp.float32)
+
+    return predict_step
+
+
 class Trainer:
     """End-to-end orchestration: mesh + model + optimizer + jitted steps.
 
@@ -315,10 +339,15 @@ class Trainer:
                 device_augment_enabled(cfg, "eval"):
             from ..ops.augment import vgg_standardize
             eval_prep = vgg_standardize
+        self._eval_prep = eval_prep
         self._eval_step = make_eval_step(eval_prep)
+        # serving forward (serve/; elaborated per bucket by
+        # analysis/elaborate.py): same prep contract as the eval step
+        self._predict_step = make_predict_step(eval_prep)
         self._jitted_train = None
         self._jitted_multi = None
         self._jitted_eval = None
+        self._jitted_predict = None
         self._dev_prefetch = None
         self._multi_prefetch = None
         self._dev_data = None
@@ -437,6 +466,15 @@ class Trainer:
         if self._jitted_eval is None:
             self._jitted_eval = jax.jit(self._eval_step)
         return self._jitted_eval
+
+    def jitted_predict_step(self):
+        """JIT entry for the serving forward — tests and ad-hoc callers;
+        the serving hot path AOT-compiles the same ``_predict_step`` per
+        batch bucket instead (serve/compile_cache.py) so the first request
+        never pays a compile."""
+        if self._jitted_predict is None:
+            self._jitted_predict = jax.jit(self._predict_step)
+        return self._jitted_predict
 
     # -- device-resident dataset (data/device_dataset.py) ------------------
     def attach_device_dataset(self, images, labels) -> None:

@@ -329,6 +329,37 @@ class EvalConfig:
 
 
 @dataclass
+class ServeConfig:
+    """AOT-compiled batched inference server (serve/; docs/serving.md).
+    Surfaced as ``main.py serve``; the reference had no serving story at
+    all — checkpoints were the end of the line (ROADMAP open item 3)."""
+
+    # request-batch cap; 0 = data.eval_batch_size. Buckets are powers of
+    # two (in multiples of Trainer.eval_pad_multiple) up to this cap
+    max_batch: int = 0
+    # how long the batcher holds the FIRST queued request to coalesce more
+    # into a bigger bucket — the p50-latency vs throughput knob (0 =
+    # dispatch immediately, smallest bucket)
+    max_queue_delay_ms: float = 5.0
+    # hot-swap poll cadence (jittered ±50%): how often the background swap
+    # thread looks for a newer committed checkpoint
+    poll_interval_secs: float = 5.0
+    # AOT-compile every bucket at startup so the first request never pays
+    # a compile; off = compile lazily on first use (counted + warned)
+    warm_buckets: bool = True
+    # -- open-loop synthetic load generator (serve/loadgen.py) ------------
+    # main.py serve drives it when load_qps > 0, then prints a JSON report
+    # and exits; load_qps = 0 serves until SIGINT/SIGTERM
+    load_qps: float = 0.0
+    load_duration_secs: float = 10.0
+    load_seed: int = 0
+    # after the load completes, keep serving (idle) until a hot swap has
+    # landed or this many extra seconds pass — scripts/serve_smoke.sh's
+    # determinism knob; 0 = exit right after the load
+    wait_for_swap_secs: float = 0.0
+
+
+@dataclass
 class ExperimentConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
     data: DataConfig = field(default_factory=DataConfig)
@@ -339,7 +370,8 @@ class ExperimentConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
-    mode: str = "train"               # train | eval | train_and_eval
+    serve: ServeConfig = field(default_factory=ServeConfig)
+    mode: str = "train"               # train | eval | train_and_eval | serve
     log_root: str = "/tmp/drt_tpu"    # reference log_root flag
 
     # ---- serialization ----

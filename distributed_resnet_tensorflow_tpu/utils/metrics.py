@@ -179,6 +179,47 @@ EVENT_SCHEMAS = {
         "fields": {"kind": "verdict kind", "exit_code": "code passed to "
                    "os._exit", "detail": "human-readable verdict"},
     },
+    "serve_request": {
+        "emitted_by": "serve/server.py InferenceServer (report cadence + "
+                      "shutdown)",
+        "fields": {
+            "step": "serving checkpoint step at export time",
+            "requests": "requests completed since process start",
+            "dropped": "requests that did not complete (contract: 0)",
+            "buckets": "per-bucket {count, p50_ms, p99_ms, mean_ms} request "
+                       "latency (submit -> result on host) — cumulative, "
+                       "like the input_stages counters",
+        },
+    },
+    "serve_batch": {
+        "emitted_by": "serve/server.py InferenceServer (per dispatched "
+                      "bucket batch)",
+        "fields": {
+            "step": "checkpoint step the batch was served from",
+            "bucket": "padded batch size dispatched",
+            "n": "real (un-padded) requests in the batch",
+            "queue_ms": "oldest request's queue wait before dispatch",
+            "run_ms": "dispatch -> logits-on-host wall time",
+        },
+    },
+    "serve_swap": {
+        "emitted_by": "serve/server.py / serve/swap.py (hot checkpoint "
+                      "swap)",
+        "fields": {
+            "from_step": "previously serving step (-1 = fresh init)",
+            "to_step": "checkpoint step now serving (absent when rejected)",
+            "digest": "manifest digest of the swapped-in checkpoint "
+                      "(resilience.manifest.manifest_digest)",
+            "restore_ms": "off-path host restore + verify wall time",
+            "apply_ms": "on-path atomic apply (device placement + pointer "
+                        "swap) wall time",
+            "rejected": "present (with the reason string) when a damaged/"
+                        "torn checkpoint failed manifest verification and "
+                        "was skipped without touching the serving params",
+            "to_step_attempted": "the rejected checkpoint's step (rejected "
+                                 "rows only; applied rows carry to_step)",
+        },
+    },
 }
 
 # unknown event names already warned about (warn once, not per row)
@@ -261,6 +302,57 @@ class MetricsWriter:
         self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
+
+
+class LatencyStats:
+    """Thread-safe per-key latency recorder with percentile summaries.
+
+    The serving path (serve/server.py) records one sample per request keyed
+    by its dispatch bucket; ``summary_ms`` is what the ``serve_request``
+    metrics rows, ``bench.py``'s serving row and the ``main.py serve``
+    report all read — one implementation so p50/p99 can't be computed three
+    different ways. Samples are capped (default 200k ≈ hours of smoke-load
+    serving) to bound memory on long-lived servers; past the cap each new
+    sample overwrites a deterministic pseudo-random slot, so the buffer
+    becomes a RECENCY-WEIGHTED window (~the last cap samples; older ones
+    decay away). For serving that is the useful estimate — current p99,
+    not a lifetime average diluted by the warm-up epoch — but it is NOT an
+    unbiased whole-run sample; ``count`` still reports the true total.
+    """
+
+    def __init__(self, max_samples_per_key: int = 200_000):
+        self._lock = threading.Lock()
+        self._samples: Dict[str, list] = {}
+        self._counts: Dict[str, int] = {}
+        self._max = max(1, max_samples_per_key)
+
+    def record(self, key: str, seconds: float) -> None:
+        with self._lock:
+            buf = self._samples.setdefault(key, [])
+            n = self._counts.get(key, 0)
+            self._counts[key] = n + 1
+            if len(buf) < self._max:
+                buf.append(seconds)
+            else:
+                # deterministic LCG slot (no random import on the hot path)
+                buf[(n * 48271 + 11) % self._max] = seconds
+
+    def summary_ms(self) -> Dict[str, Dict[str, float]]:
+        """key -> {count, p50_ms, p99_ms, mean_ms} over recorded samples."""
+        import numpy as np
+        with self._lock:
+            snap = {k: (list(v), self._counts.get(k, 0))
+                    for k, v in self._samples.items()}
+        out = {}
+        for key, (vals, count) in snap.items():
+            if not vals:
+                continue
+            arr = np.asarray(vals) * 1000.0
+            out[key] = {"count": count,
+                        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+                        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+                        "mean_ms": round(float(arr.mean()), 3)}
+        return out
 
 
 class Throughput:

@@ -130,6 +130,22 @@ def test_suppression_comment_silences_rule(bad_repo):
     assert "bare-assert" in _by_rule(run_lint(bad_repo))
 
 
+def test_stray_device_put_covers_serve_tree(tmp_path):
+    """The serving subsystem inherits the transfer invariant: a raw
+    ``jax.device_put`` anywhere under serve/ (batcher, swap apply, a future
+    request path) is a finding — serve transfers go through
+    parallel/sharding.py (put_to_sharding / the CoalescedStager), full stop
+    (docs/serving.md; ISSUE: no new raw device_put sites)."""
+    pkg = tmp_path / PKG / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue.py").write_text(
+        "import jax\n\n\ndef apply_swap(tree, shardings):\n"
+        "    return jax.device_put(tree, shardings)\n")
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    hits = {(f.path, f.line) for f in by_rule.get("stray-device-put", ())}
+    assert (os.path.join(PKG, "serve", "rogue.py"), 5) in hits
+
+
 def test_syntax_error_is_a_finding(tmp_path):
     pkg = tmp_path / PKG
     pkg.mkdir()
@@ -273,6 +289,32 @@ def test_elaborator_clean_on_smoke_preset(devices):
         run_elaborate)
     findings = run_elaborate(["smoke"])
     assert findings == [], format_findings(findings, verbose=True)
+
+
+def test_elaborator_traces_serve_step_per_bucket(devices, monkeypatch):
+    """The serve/predict step is elaborated per bucket: a predict step
+    that cannot trace becomes an elab-serve-step finding naming the
+    bucket, instead of a serving replica dying while warming its AOT
+    cache (serve/compile_cache.py)."""
+    from distributed_resnet_tensorflow_tpu.analysis.elaborate import (
+        elaborate_config)
+    from distributed_resnet_tensorflow_tpu.train import loop as loop_mod
+    from distributed_resnet_tensorflow_tpu.utils.config import (
+        MeshConfig, get_preset)
+
+    def broken_predict_step(prep_fn=None):
+        def step(state, batch):
+            raise ValueError("serve step fixture breakage")
+        return step
+
+    monkeypatch.setattr(loop_mod, "make_predict_step", broken_predict_step)
+    cfg = get_preset("smoke")
+    cfg.model.resnet_size = 8
+    cfg.data.image_size = 8
+    findings = elaborate_config(cfg, MeshConfig(data=8), "fixture@dp")
+    serve_findings = [f for f in findings if f.rule == "elab-serve-step"]
+    assert serve_findings, format_findings(findings, verbose=True)
+    assert "bucket" in serve_findings[0].message
 
 
 def test_check_cli_lint_only():

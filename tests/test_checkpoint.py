@@ -158,6 +158,20 @@ def test_wait_for_new_checkpoint(tmp_path):
     mngr.wait_until_finished()
     assert wait_for_new_checkpoint(d, None, timeout_secs=0.0) == 5
     assert wait_for_new_checkpoint(d, 5, timeout_secs=0.0) is None
+
+    # the non-blocking variant (serve swap thread + jittered evaluator):
+    # (step, path, manifest digest) triple, None when nothing newer
+    from distributed_resnet_tensorflow_tpu.checkpoint import (
+        poll_new_checkpoint)
+    from distributed_resnet_tensorflow_tpu.resilience.manifest import (
+        manifest_digest)
+    hit = poll_new_checkpoint(d, None)
+    assert hit is not None
+    step, path, digest = hit
+    assert step == 5 and path == os.path.join(d, "5")
+    assert digest and digest == manifest_digest(path)  # committed → hashed
+    assert poll_new_checkpoint(d, 5) is None
+    assert poll_new_checkpoint(str(tmp_path / "nope"), None) is None
     mngr.close()
 
 

@@ -1,0 +1,403 @@
+"""serve/ — AOT batched inference server with hot checkpoint swap.
+
+Covers the contracts docs/serving.md promises: bucket selection + padding
+(bucketed logits == unbatched eval logits), queue-delay coalescing under
+concurrent submitters, hot-swap atomicity (in-flight requests complete on
+the old params, the next batch sees the new step), torn checkpoints
+rejected by manifest verification without disturbing the serving params,
+and the whole arrangement running clean under the cross-thread dispatch
+sanitizer (the PR 2 single-dispatch-thread constraint, enforced)."""
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_resnet_tensorflow_tpu.checkpoint import CheckpointManager
+from distributed_resnet_tensorflow_tpu.serve import (InferenceServer,
+                                                     bucket_sizes,
+                                                     pick_bucket)
+from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+
+def _tiny_cfg(tmp_path, **kw):
+    cfg = get_preset("smoke")
+    cfg.model.compute_dtype = "float32"
+    cfg.model.resnet_size = 8
+    cfg.model.num_classes = 4
+    cfg.data.image_size = 8
+    cfg.data.eval_batch_size = 16       # buckets on the 8-dev mesh: [8, 16]
+    cfg.train.batch_size = 16
+    cfg.log_root = str(tmp_path)
+    cfg.checkpoint.directory = os.path.join(str(tmp_path), "ckpt")
+    cfg.checkpoint.async_save = False
+    cfg.serve.max_queue_delay_ms = 20.0
+    cfg.serve.poll_interval_secs = 0.2
+    for k, v in kw.items():
+        cfg.override(k, v)
+    return cfg
+
+
+def _images(n, rng=None):
+    rng = rng or np.random.RandomState(0)
+    return rng.randn(n, 8, 8, 3).astype(np.float32)
+
+
+def _commit(cfg, server, step, scale=None):
+    """Commit the server's current params (optionally rescaled) as a
+    checkpoint at ``step`` — the training publisher stand-in. Everything
+    happens HOST-side (np.asarray pulls + numpy math): the threaded tests
+    run under the dispatch sanitizer with the dispatch thread owning
+    multi-device executions, so the publisher must not launch any."""
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False,
+                             max_to_keep=100)
+    st = server.trainer.state
+
+    def host(x):
+        return np.asarray(x)
+
+    params = jax.tree_util.tree_map(
+        (lambda x: host(x) * scale) if scale is not None else host,
+        st.params)
+    st = st.replace(step=np.asarray(step, np.int32), params=params,
+                    batch_stats=jax.tree_util.tree_map(host, st.batch_stats),
+                    opt_state=jax.tree_util.tree_map(host, st.opt_state))
+    mngr.save(step, st, force=True)
+    mngr.close()
+
+
+# ---------------------------------------------------------------------------
+# pure helpers
+# ---------------------------------------------------------------------------
+
+def test_bucket_sizes_power_of_two_with_pad_floor():
+    assert bucket_sizes(16, 8) == [8, 16]
+    assert bucket_sizes(100, 8) == [8, 16, 32, 64, 104]  # cap rounded up
+    assert bucket_sizes(4, 1) == [1, 2, 4]
+    assert bucket_sizes(1, 1) == [1]
+    with pytest.raises(ValueError):
+        bucket_sizes(0, 8)
+
+
+def test_pick_bucket_smallest_fit():
+    buckets = [8, 16, 32]
+    assert pick_bucket(buckets, 1) == 8
+    assert pick_bucket(buckets, 8) == 8
+    assert pick_bucket(buckets, 9) == 16
+    with pytest.raises(ValueError):
+        pick_bucket(buckets, 33)
+
+
+def test_pad_batch_to_bucket_mask_semantics():
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import (
+        pad_batch_to_bucket)
+    batch = {"images": np.ones((3, 4, 4, 3), np.float32),
+             "labels": np.arange(3, dtype=np.int32)}
+    out = pad_batch_to_bucket(batch, 8)
+    assert out["images"].shape == (8, 4, 4, 3)
+    assert out["labels"].shape == (8,)
+    np.testing.assert_array_equal(out["mask"],
+                                  [1, 1, 1, 0, 0, 0, 0, 0])
+    # already at the bucket: untouched content, full mask
+    full = pad_batch_to_bucket(batch, 3)
+    np.testing.assert_array_equal(full["mask"], [1, 1, 1])
+    with pytest.raises(ValueError):
+        pad_batch_to_bucket(batch, 2)
+
+
+def test_serve_events_registered():
+    # the registry-drift lint enforces this statically; this is the cheap
+    # runtime tripwire against a rename that dodges the linter
+    from distributed_resnet_tensorflow_tpu.utils.metrics import EVENT_SCHEMAS
+    for name in ("serve_request", "serve_batch", "serve_swap"):
+        assert name in EVENT_SCHEMAS
+
+
+# ---------------------------------------------------------------------------
+# serving correctness (deterministic single-thread driving)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.heavy
+def test_bucketed_logits_match_unbatched_eval(tmp_path):
+    """Bucket selection + padding correctness: logits served out of a
+    padded bucket batch equal the unbatched eval forward per example
+    (train=False BN → rows are batch-independent)."""
+    cfg = _tiny_cfg(tmp_path)
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    imgs = _images(3)
+    futures = [server.submit(im) for im in imgs]
+    served = server.service_once()
+    assert served == 3
+    # all three coalesced into the smallest fitting bucket (8)
+    assert server.batcher.batches == 1
+    assert server.latency.summary_ms()["bucket_8"]["count"] == 3
+    # warm cache honored: the request paid zero compiles
+    assert server.cache.serve_time_compiles == 0
+
+    predict = server.trainer.jitted_predict_step()
+    for im, fut in zip(imgs, futures):
+        row, step = fut.result(timeout=5)
+        assert step == -1  # fresh init, no checkpoint
+        ref = np.asarray(predict(server.trainer.state, {"images": im[None]}))
+        np.testing.assert_allclose(row, ref[0], rtol=1e-5, atol=1e-5)
+
+    # spec violations are rejected loudly, never silently cast/served:
+    # a uint8 image against the float32 spec would serve unstandardized
+    # pixels, a wrong shape a garbled batch
+    with pytest.raises(ValueError):
+        server.submit((imgs[0] * 255).astype(np.uint8))
+    with pytest.raises(ValueError):
+        server.submit(np.zeros((4, 4, 3), np.float32))
+    server.close()
+    assert server.dropped == 0
+
+
+@pytest.mark.heavy
+def test_hot_swap_atomicity(tmp_path):
+    """In-flight requests complete on the OLD params; the batch after the
+    boundary sees the new checkpoint step; torn checkpoints are rejected
+    without touching the serving params."""
+    cfg = _tiny_cfg(tmp_path)
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    img = _images(1)[0]
+
+    # publish step 7 with rescaled params, make it pending
+    _commit(cfg, server, 7, scale=0.5)
+    pending = server.swapper.poll_once()
+    assert pending is not None and pending.step == 7
+
+    f_old = server.submit(img)
+    server.service_once()     # dispatches f_old, THEN applies the swap
+    row_old, step_old = f_old.result(timeout=5)
+    assert step_old == -1     # in-flight batch finished on the old params
+    assert server.serving_step == 7  # swap landed at the batch boundary
+
+    f_new = server.submit(img)
+    server.service_once()
+    row_new, step_new = f_new.result(timeout=5)
+    assert step_new == 7
+    # the swapped params are actually live (logits changed)
+    assert not np.allclose(row_old, row_new)
+    server.close()
+    assert server.dropped == 0 and server.swaps == 1
+
+
+@pytest.mark.heavy
+def test_torn_checkpoint_rejected_serving_undisturbed(tmp_path):
+    cfg = _tiny_cfg(tmp_path)
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    img = _images(1)[0]
+
+    # good step 3 swaps in
+    _commit(cfg, server, 3)
+    assert server.swapper.poll_once() is not None
+    server.service_once()
+    assert server.serving_step == 3
+
+    # step 5 committed, then damaged after commit (truncation/bit rot):
+    # manifest verification must reject it off the request path
+    _commit(cfg, server, 5, scale=2.0)
+    _corrupt_step(cfg, 5)
+    assert server.swapper.poll_once() is None
+    assert server.swapper.rejected == 1
+    f1 = server.submit(img)
+    server.service_once()
+    assert f1.result(timeout=5)[1] == 3  # still serving the old step
+    assert server.serving_step == 3
+
+    # a later GOOD commit still swaps in (the bad step was skipped, not
+    # retried forever)
+    _commit(cfg, server, 9, scale=3.0)
+    assert server.swapper.poll_once() is not None
+    server.service_once()
+    assert server.serving_step == 9
+
+    # hot-path fallback: TWO new commits land between polls and the
+    # newest tears — the poll must surface the older GOOD one instead of
+    # leaving the replica stale (same contract as the startup walk)
+    _commit(cfg, server, 12, scale=4.0)
+    _commit(cfg, server, 15, scale=5.0)
+    _corrupt_step(cfg, 15)
+    pending = server.swapper.poll_once()
+    assert pending is not None and pending.step == 12
+    server.service_once()
+    assert server.serving_step == 12
+    assert server.swapper.poll_once() is None  # 15 skipped, not re-tried
+    server.close()
+    assert server.dropped == 0
+
+
+def _corrupt_step(cfg, step):
+    step_dir = os.path.join(cfg.checkpoint.directory, str(step))
+    payloads = [os.path.join(dp, f)
+                for dp, _, fs in os.walk(step_dir) for f in fs
+                if f != "MANIFEST.json"]
+    with open(max(payloads, key=os.path.getsize), "ab") as f:
+        f.write(b"torn")
+
+
+@pytest.mark.heavy
+def test_startup_falls_back_past_torn_newest(tmp_path):
+    """A restarting replica whose NEWEST commit is torn serves the newest
+    older checkpoint that verifies — never fresh-init params."""
+    cfg = _tiny_cfg(tmp_path)
+    boot = InferenceServer(cfg)
+    _commit(cfg, boot, 2)
+    _commit(cfg, boot, 5, scale=2.0)
+    _corrupt_step(cfg, 5)
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    assert server.serving_step == 2          # fell back, not random init
+    assert server.swapper.rejected == 1
+    assert server.swaps == 0
+    # the background poll is anchored PAST the damaged newest step: only
+    # a genuinely newer commit swaps in
+    assert server.swapper.poll_once() is None
+    _commit(cfg, server, 8, scale=3.0)
+    assert server.swapper.poll_once() is not None
+    server.service_once()
+    assert server.serving_step == 8
+    server.close()
+
+
+@pytest.mark.heavy
+def test_mismatched_checkpoint_rejected_without_poisoning(tmp_path):
+    """A same-tree checkpoint from a DIFFERENT model config (other
+    num_classes → other head shape) is rejected at apply time; serving
+    continues on the old params instead of poisoning every later batch
+    with an executable/input mismatch."""
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    cfg = _tiny_cfg(tmp_path)
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    img = _images(1)[0]
+
+    other_cfg = _tiny_cfg(tmp_path, **{"model.num_classes": "10"})
+    other = Trainer(other_cfg)
+    other.init_state()
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False)
+    host = jax.tree_util.tree_map(np.asarray, other.state)
+    mngr.save(4, host.replace(step=np.asarray(4, np.int32)), force=True)
+    mngr.close()
+
+    assert server.swapper.poll_once() is not None  # loads fine host-side
+    f1 = server.submit(img)
+    server.service_once()          # apply attempt at the boundary: reject
+    assert server.serving_step == -1 and server.swaps == 0
+    assert server.swapper.rejected == 1
+    # the replica still answers (no poisoned state swapped in)
+    assert f1.result(timeout=5)[0].shape == (4,)
+    f2 = server.submit(img)
+    server.service_once()
+    assert f2.result(timeout=5)[0].shape == (4,)
+    server.close()
+    assert server.dropped == 0 and server.batcher.errors == 0
+
+
+@pytest.mark.heavy
+def test_startup_restore_applied_once_and_not_a_hot_swap(tmp_path):
+    """A checkpoint present at startup is applied exactly once (not
+    re-applied by the first batch-boundary hook) and does NOT count as a
+    hot swap — `swaps` only counts checkpoints published while serving."""
+    cfg = _tiny_cfg(tmp_path)
+    boot = InferenceServer(cfg)       # only to mint a checkpoint to serve
+    _commit(cfg, boot, 2)
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    assert server.serving_step == 2
+    assert server.swaps == 0          # startup restore is not a hot swap
+    assert not server.swapper.has_pending  # claimed, not parked
+    f = server.submit(_images(1)[0])
+    server.service_once()             # boundary hook must not re-apply
+    assert f.result(timeout=5)[1] == 2
+    assert server.swaps == 0
+    _commit(cfg, server, 6, scale=0.5)
+    assert server.swapper.poll_once() is not None
+    server.service_once()
+    assert server.serving_step == 6 and server.swaps == 1
+    server.close()
+
+
+@pytest.mark.heavy
+def test_close_drains_queued_requests_without_dispatch_thread(tmp_path):
+    """Thread-less mode: requests still queued at close() are served by
+    the closing (caller) thread — accepted means answered."""
+    cfg = _tiny_cfg(tmp_path)
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    futures = [server.submit(im) for im in _images(3)]
+    server.close()                    # no service_once ran
+    assert all(f.result(timeout=5)[0].shape == (4,) for f in futures)
+    assert server.dropped == 0
+    with pytest.raises(RuntimeError):
+        server.submit(_images(1)[0])  # intake sealed
+
+
+# ---------------------------------------------------------------------------
+# threaded serving (real dispatch + swap threads)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.heavy
+def test_queue_delay_batching_under_concurrent_submitters(tmp_path):
+    """Concurrent submitters coalesce: N requests land in far fewer than N
+    dispatched batches under a generous queue delay, and every future
+    resolves (zero dropped)."""
+    import threading
+    cfg = _tiny_cfg(tmp_path, **{"serve.max_queue_delay_ms": "300"})
+    server = InferenceServer(cfg)
+    server.start(start_threads=True)
+    imgs = _images(6, np.random.RandomState(1))
+    futures = [None] * 6
+
+    def submitter(i):
+        futures[i] = server.submit(imgs[i])
+
+    threads = [threading.Thread(target=submitter, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rows = [f.result(timeout=30) for f in futures]
+    assert len(rows) == 6
+    server.close()
+    assert server.dropped == 0 and server.batcher.errors == 0
+    # 6 requests within a 300ms window → coalesced, not 6 single-row
+    # batches (allow scheduler slop: the first dispatch may slip out alone)
+    assert 1 <= server.batcher.batches <= 3
+    counts = {k: v["count"]
+              for k, v in server.latency.summary_ms().items()}
+    assert sum(counts.values()) == 6
+
+
+@pytest.mark.heavy
+def test_threaded_swap_and_sanitizer_clean(tmp_path):
+    """End-to-end with REAL dispatch + swap threads, under the cross-thread
+    dispatch sanitizer: requests served, a checkpoint published mid-serve
+    hot-swaps in (applied by the dispatch thread, idle or not), no
+    CrossThreadDispatchError, zero dropped requests."""
+    from distributed_resnet_tensorflow_tpu.analysis import dispatch_sanitizer
+    cfg = _tiny_cfg(tmp_path)
+    server = InferenceServer(cfg)
+    with dispatch_sanitizer.enabled():
+        server.start(start_threads=True)
+        imgs = _images(4, np.random.RandomState(2))
+        pre = [server.submit(im) for im in imgs]
+        assert all(f.result(timeout=30)[1] == -1 for f in pre)
+
+        _commit(cfg, server, 11, scale=0.25)
+        deadline = time.monotonic() + 20
+        while server.swaps == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.swaps == 1, "hot swap never landed"
+
+        post = [server.submit(im) for im in imgs]
+        assert all(f.result(timeout=30)[1] == 11 for f in post)
+        server.close()
+    assert server.batcher.errors == 0
+    assert server.dropped == 0
+    assert server.cache.serve_time_compiles == 0
