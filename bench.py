@@ -569,6 +569,77 @@ def bench_imagenet_norm(budget_left):
     return out
 
 
+def bench_goodput(budget_left):
+    """The goodput/step-breakdown row (telemetry/; docs/observability.md):
+    a short REAL-input streamed training run with the flight-recorder
+    spans on (the default) and a live checkpoint cadence, classified by
+    the goodput meter into {compute, input_wait, checkpoint, eval, stall,
+    restart}. Acceptance contract: the categories sum to ~100% of the
+    measured wall (compute is the remainder by construction — pct_sum is
+    the witness), and the spans-on steps/s of the CIFAR headline stays
+    within 2% of its baseline (the headline row itself, measured with
+    spans enabled process-wide)."""
+    import shutil
+
+    from distributed_resnet_tensorflow_tpu.checkpoint import CheckpointManager
+    from distributed_resnet_tensorflow_tpu.data import create_input_iterator
+    from distributed_resnet_tensorflow_tpu.telemetry import goodput, recorder
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.train.hooks import CheckpointHook
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    if budget_left() < 60:
+        return {"skipped": "over bench budget"}
+    cfg = get_preset("cifar10_resnet50")
+    # resnet-20: the row measures the goodput CLASSIFIER over a real
+    # streamed-input train loop with a live checkpoint cadence, not model
+    # throughput (the headline rows cover that) — and it must stay cheap
+    # enough to run on a CPU smoke box, where RN50 would eat the budget
+    cfg.model.resnet_size = 20
+    cfg.data.data_dir = _synth_cifar_files()
+    cfg.mesh.data = len(jax.devices())
+    ckpt_dir = os.path.join(tempfile.gettempdir(), "drt_bench_goodput_ckpt")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    trainer = Trainer(cfg)
+    trainer.init_state()
+    # time-based cadence so the row exercises the checkpoint bucket on
+    # ANY backend speed (a step cadence would never fire inside the
+    # window on a slow CPU box)
+    manager = CheckpointManager(ckpt_dir, save_every_steps=0,
+                                save_every_secs=8.0, max_to_keep=2)
+    stream = create_input_iterator(cfg, mode="train")
+    trainer.train(stream, num_steps=5)  # warmup/compile
+    jax.block_until_ready(trainer.state.params)
+    goodput.rebase()
+    # wall-bounded, not step-bounded: ~25s of steady state whether the
+    # backend does 3 steps/s (CPU smoke) or 400 (TPU)
+    window = min(25.0, max(10.0, budget_left() - 30))
+    hook = CheckpointHook(manager)
+    step, n = 5, 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < window and n < 20_000:
+        trainer.train(stream, num_steps=step + 20, start_step=step,
+                      hooks=(hook,))
+        step += 20
+        n += 20
+    manager.close()  # drain the async save inside the timed window
+    jax.block_until_ready(trainer.state.params)
+    wall = time.perf_counter() - t0
+    itv = goodput.interval()
+    pct_sum = round(sum(itv["pct"].values()), 2)
+    return {
+        "steps": n,
+        "steps_per_sec": round(n / wall, 2),
+        "wall_secs": round(wall, 3),
+        "classified_wall_secs": itv["wall_secs"],
+        "seconds": itv["seconds"],
+        "pct": itv["pct"],
+        "pct_sum": pct_sum,
+        "spans_recorded": len(recorder),
+        "spans_enabled": recorder.enabled,
+    }
+
+
 def bench_serving(budget_left):
     """The serving row (serve/; docs/serving.md): open-loop synthetic load
     against the AOT-compiled batched inference server — p50/p99 request
@@ -699,6 +770,11 @@ def main():
                      else {"skipped": "over bench budget"}),
                     # the serving row (serve/): p50/p99 + QPS per bucket
                     ("serving", lambda: bench_serving(budget_left)),
+                    # goodput/step-breakdown (telemetry/): where a real
+                    # streamed training run's wall-clock went — the
+                    # before/after number for ROADMAP items 2 and 5
+                    ("goodput_breakdown",
+                     lambda: bench_goodput(budget_left)),
                     ("imagenet_norm_contracts",
                      lambda: bench_imagenet_norm(budget_left))):
         if time.monotonic() - t0 > budget:

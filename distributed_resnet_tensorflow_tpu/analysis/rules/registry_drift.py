@@ -1,17 +1,22 @@
-"""event-registry + config-knob: names in code/scripts/docs must resolve.
+"""event-registry + span-catalog + config-knob: names must resolve.
 
-Two drift checks against the project's declared registries:
+Three drift checks against the project's declared registries:
 
   * every ``write_event("<name>", ...)`` literal in code and every
     ``{"event": "<name>"}`` mention in docs/scripts must be declared in
     ``utils.metrics.EVENT_SCHEMAS`` — the one source of truth for the
     metrics.jsonl event stream;
+  * every ``span("<name>")`` literal passed to the flight-recorder tracer
+    (and every ``span("<name>")`` mention in docs/scripts) must be
+    declared in ``telemetry.tracer.SPAN_CATALOG`` — trace.json consumers
+    and the goodput classifier key on these names, so an unregistered
+    span is invisible drift exactly like an unregistered event;
   * every ``--set a.b.c=`` knob referenced in code, scripts or docs must
     resolve against the ``utils.config.ExperimentConfig`` dataclasses —
     the knob a README advertises must actually exist (``cfg.override``
     raises at runtime, but docs and sbatch scripts never run under CI).
 
-Both catch the "renamed it in code, forgot the docs/launcher" class that
+All catch the "renamed it in code, forgot the docs/launcher" class that
 otherwise surfaces as a crashed job after a 20-minute queue wait.
 """
 from __future__ import annotations
@@ -36,11 +41,18 @@ _KNOB_PLACEHOLDERS = {"k", "key", "KEY", "a.b.c", "dotted.path", "x.y.z"}
 _KNOB_RE = re.compile(
     r'--set[\s"=]+(?:([A-Za-z_][\w.]*\.\*)|([A-Za-z_][\w.]*)\s*=)')
 _DOC_EVENT_RE = re.compile(r'"event"\s*:\s*"(\w+)"')
+# span-name mentions in docs/scripts: span("input.wait") / ``span("x.y")``
+_DOC_SPAN_RE = re.compile(r'span\(\s*"([\w.]+)"')
 
 
 def _event_names() -> set:
     from ...utils.metrics import EVENT_SCHEMAS
     return set(EVENT_SCHEMAS)
+
+
+def _span_names() -> set:
+    from ...telemetry.tracer import SPAN_CATALOG
+    return set(SPAN_CATALOG)
 
 
 def _knob_resolves(dotted: str) -> bool:
@@ -63,27 +75,47 @@ def _is_write_event(node: ast.Call) -> bool:
         fn.attr in ("write_event", "_write_event")
 
 
+def _is_span_call(node: ast.Call) -> bool:
+    """``span("...")`` (the module-level convenience) or
+    ``recorder.span("...")`` — the two spellings the tracer exports.
+    Deliberately NOT any ``<obj>.span(...)``: an unrelated API named span
+    (e.g. a regex match group helper) must not turn the gate red."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "span":
+        return True
+    return isinstance(fn, ast.Attribute) and fn.attr == "span" and \
+        isinstance(fn.value, ast.Name) and fn.value.id == "recorder"
+
+
 def check(ctx) -> Iterable[Finding]:
     events = _event_names()
+    spans = _span_names()
 
-    # (a) write_event literals in python
+    # (a) write_event + span literals in python
     for sf in ctx.all_python():
         if sf.tree is None:
             continue
         for node in ast.walk(sf.tree):
-            if isinstance(node, ast.Call) and _is_write_event(node) \
-                    and node.args:
-                arg = node.args[0]
-                if isinstance(arg, ast.Constant) and \
-                        isinstance(arg.value, str) and \
-                        arg.value not in events:
-                    yield Finding(
-                        RULE_NAME, sf.rel, node.lineno,
-                        f"metrics event {arg.value!r} is not declared in "
-                        "utils.metrics.EVENT_SCHEMAS — register it there "
-                        "first")
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            if _is_write_event(node) and arg.value not in events:
+                yield Finding(
+                    RULE_NAME, sf.rel, node.lineno,
+                    f"metrics event {arg.value!r} is not declared in "
+                    "utils.metrics.EVENT_SCHEMAS — register it there "
+                    "first")
+            elif _is_span_call(node) and arg.value not in spans:
+                yield Finding(
+                    RULE_NAME, sf.rel, node.lineno,
+                    f"tracer span {arg.value!r} is not declared in "
+                    "telemetry.tracer.SPAN_CATALOG — register it there "
+                    "first")
 
-    # (b) {"event": "<name>"} mentions in docs + scripts
+    # (b) {"event": "<name>"} and span("<name>") mentions in docs + scripts
     for sf in ctx.docs + ctx.scripts:
         for i, line in enumerate(sf.lines, 1):
             for m in _DOC_EVENT_RE.finditer(line):
@@ -93,6 +125,13 @@ def check(ctx) -> Iterable[Finding]:
                         f"documented metrics event {m.group(1)!r} does not "
                         "exist in utils.metrics.EVENT_SCHEMAS — stale doc "
                         "or missing registration")
+            for m in _DOC_SPAN_RE.finditer(line):
+                if m.group(1) not in spans:
+                    yield Finding(
+                        RULE_NAME, sf.rel, i,
+                        f"documented tracer span {m.group(1)!r} does not "
+                        "exist in telemetry.tracer.SPAN_CATALOG — stale "
+                        "doc or missing registration")
 
     # (c) --set knob references everywhere
     for sf in ctx.all_python() + ctx.scripts + ctx.docs:

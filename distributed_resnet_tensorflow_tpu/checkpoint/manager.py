@@ -42,6 +42,7 @@ from ..resilience.manifest import (committed_steps, manifest_status,
                                    staging_path, sweep_staging,
                                    fsync_dir, write_manifest)
 from ..resilience.retry import retry_call
+from ..telemetry.tracer import span
 
 log = logging.getLogger(__name__)
 
@@ -237,28 +238,36 @@ class CheckpointManager:
         checkpoint from an earlier run in the same directory must not
         swallow the current state (the cadence policy lives in
         ``maybe_save``, which never forces)."""
-        self.wait_until_finished()  # serialize with an in-flight async save
-        if step in self.all_steps() and not force:
-            return  # idempotent: step already checkpointed
-        self._check_layout()
-        if self._layout_stamp is not None:
-            saved = self.saved_layout()
-            # rewrite when the layout differs OR the existing stamp's
-            # applies_from_step is ahead of this commit (a crash orphan
-            # from an earlier run; left alone it would outrank every step
-            # this run commits and _check_layout would keep discarding it)
-            if (self._strip_meta(saved) != self._layout_stamp
-                    or (saved or {}).get("applies_from_step", step) > step):
-                self._write_layout(step)
-        tree = _saveable(state)
-        if self._async:
-            snapshot = _host_snapshot(tree)
-            self._pending = self._executor.submit(self._write, step,
-                                                  snapshot, force)
-        else:
-            self._write(step, tree, force)
-        self._last_save_time = time.monotonic()
-        self._last_save_step = step
+        # goodput: everything the STEP-LOOP thread pays for this save (the
+        # drain of a previous in-flight save, the host snapshot, and — on
+        # the sync path — the whole write) is checkpoint wall, not compute
+        # (telemetry/goodput.py; the nested wait span charges nothing
+        # extra under the outermost-categorized-span rule)
+        with span("checkpoint.save", category="checkpoint", step=step):
+            self.wait_until_finished()  # serialize with in-flight async save
+            if step in self.all_steps() and not force:
+                return  # idempotent: step already checkpointed
+            self._check_layout()
+            if self._layout_stamp is not None:
+                saved = self.saved_layout()
+                # rewrite when the layout differs OR the existing stamp's
+                # applies_from_step is ahead of this commit (a crash orphan
+                # from an earlier run; left alone it would outrank every
+                # step this run commits and _check_layout would keep
+                # discarding it)
+                if (self._strip_meta(saved) != self._layout_stamp
+                        or (saved or {}).get("applies_from_step",
+                                             step) > step):
+                    self._write_layout(step)
+            tree = _saveable(state)
+            if self._async:
+                snapshot = _host_snapshot(tree)
+                self._pending = self._executor.submit(self._write, step,
+                                                      snapshot, force)
+            else:
+                self._write(step, tree, force)
+            self._last_save_time = time.monotonic()
+            self._last_save_step = step
 
     def _write(self, step: int, tree, force: bool = False) -> None:
         """Stage → manifest(fsync) → rename(commit) → retention."""
@@ -287,13 +296,19 @@ class CheckpointManager:
             if chief and os.path.isdir(staging):
                 shutil.rmtree(staging)
             # every process participates: orbax writes this process's array
-            # shards and barriers internally before finalizing the payload
-            self._ckptr.save(os.path.join(staging, _PAYLOAD_DIR),
-                             args=ocp.args.StandardSave(tree))
+            # shards and barriers internally before finalizing the payload.
+            # Flight-recorder spans split the commit protocol so a dump
+            # shows WHICH leg a slow/stuck save was in (stage vs fsync vs
+            # rename) — runs on the writer thread when async
+            with span("checkpoint.stage", step=step):
+                self._ckptr.save(os.path.join(staging, _PAYLOAD_DIR),
+                                 args=ocp.args.StandardSave(tree))
             if chief:
-                write_manifest(staging, step)
-                os.replace(staging, final)
-                fsync_dir(self.directory)
+                with span("checkpoint.fsync", step=step):
+                    write_manifest(staging, step)
+                with span("checkpoint.commit", step=step):
+                    os.replace(staging, final)
+                    fsync_dir(self.directory)
 
         multi = jax.process_count() > 1
         error: Optional[BaseException] = None
@@ -510,7 +525,10 @@ class CheckpointManager:
         re-raises its error so a failed save can't pass silently."""
         pending, self._pending = self._pending, None
         if pending is not None:
-            pending.result()
+            # goodput: the caller (step-loop) thread is stalled on
+            # checkpoint I/O right here
+            with span("checkpoint.wait", category="checkpoint"):
+                pending.result()
 
     def close(self) -> None:
         self.wait_until_finished()

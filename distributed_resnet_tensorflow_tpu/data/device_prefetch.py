@@ -74,6 +74,7 @@ def device_prefetch(host_iter: Iterator, put: Callable, depth: int = 2
     """
     import jax
 
+    from ..telemetry.tracer import span
     from ..utils.metrics import input_stages
 
     # a put that records its own stage counters (CoalescedStager splits
@@ -98,11 +99,12 @@ def device_prefetch(host_iter: Iterator, put: Callable, depth: int = 2
             try:
                 # StagedBatch exposes block_until_ready (transfer only);
                 # plain pytrees block leaf-wise
-                blocker = getattr(dev, "block_until_ready", None)
-                if blocker is not None:
-                    blocker()
-                else:
-                    jax.block_until_ready(dev)
+                with span("input.transfer"):
+                    blocker = getattr(dev, "block_until_ready", None)
+                    if blocker is not None:
+                        blocker()
+                    else:
+                        jax.block_until_ready(dev)
             except Exception:
                 pass  # non-jax payloads (tests stub put with plain values)
             wait_s = time.perf_counter() - t0
@@ -115,7 +117,8 @@ def device_prefetch(host_iter: Iterator, put: Callable, depth: int = 2
             for batch in host_iter:
                 items = _batch_items(batch)
                 t0 = time.perf_counter()
-                out = put(batch)
+                with span("input.stage"):
+                    out = put(batch)
                 issue_s = time.perf_counter() - t0
                 if prev is not None:
                     charge(prev)
@@ -250,6 +253,7 @@ def threaded_stacker(host_iter: Iterator, k: int, depth: int = 2) -> Iterator:
     """
     import numpy as np
 
+    from ..telemetry.tracer import span
     from ..utils.metrics import input_stages
 
     def groups():
@@ -266,8 +270,9 @@ def threaded_stacker(host_iter: Iterator, k: int, depth: int = 2) -> Iterator:
                         "group)", len(batches), k)
                 return
             t0 = time.perf_counter()
-            out = {key: np.stack([b[key] for b in batches])
-                   for key in batches[0]}
+            with span("input.stack"):
+                out = {key: np.stack([b[key] for b in batches])
+                       for key in batches[0]}
             input_stages.add("stack", time.perf_counter() - t0,
                              items=_batch_items(out))
             yield out

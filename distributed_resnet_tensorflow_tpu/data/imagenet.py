@@ -210,6 +210,11 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                 "all hosts (or set data.use_native_loader=false fleet-"
                 "wide)")
 
+    # worker processes ship their decode stage-counters back as
+    # _StageDelta messages on the result queue (merged below): without the
+    # merge, bench's input attribution under decode_processes > 0
+    # undercounted decode busy time — the workers' own registries die with
+    # the workers
     if use_procs:
         import multiprocessing as mp
         # NOT "fork": the parent is multi-threaded by the time an iterator
@@ -232,7 +237,7 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                               seed * 7919 if deterministic
                               else seed * 7919 + i,
                               is_train, image_size, native_decode,
-                              emit_uint8, deterministic),
+                              emit_uint8, deterministic, i),
                         daemon=True)
             for i in range(n_workers)]
         for w in workers:
@@ -281,7 +286,7 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
             wseed = seed * 7919 if deterministic else seed * 7919 + widx
             _decode_loop(in_q, out_q, wseed, is_train,
                          image_size, native_decode, emit_uint8, stop,
-                         deterministic)
+                         deterministic, widx)
         except BaseException as e:
             out_q.put(_Failure(repr(e)))
 
@@ -329,9 +334,18 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                             f"reporting: exitcodes "
                             f"{[w.exitcode for w in dead]}") from None
 
+        from ..utils.metrics import input_stages
         try:
             while True:
                 item = next_item()
+                if isinstance(item, _StageDelta):
+                    # decode-PROCESS counter snapshot: merge into the
+                    # parent registry under a per-worker key so
+                    # max_thread_seconds still means "busiest worker"
+                    input_stages.add("decode", item.seconds,
+                                     items=item.count, nbytes=item.nbytes,
+                                     worker=("decode-proc", item.widx))
+                    continue
                 if isinstance(item, _Failure):
                     raise RuntimeError(
                         f"imagenet pipeline worker failed: {item.err}")
@@ -388,6 +402,21 @@ class _EndMarker:
     """Worker-exhausted sentinel that survives a multiprocessing queue."""
 
 
+class _StageDelta:
+    """A decode worker PROCESS's stage-counter increment, shipped to the
+    parent over the result queue (pickle-friendly; see ``_decode_loop``).
+    The parent merges it into ``utils.metrics.input_stages`` so bench's
+    input attribution sees process-pool decode busy time too."""
+
+    __slots__ = ("widx", "count", "seconds", "nbytes")
+
+    def __init__(self, widx: int, count: int, seconds: float, nbytes: int):
+        self.widx = widx
+        self.count = count
+        self.seconds = seconds
+        self.nbytes = nbytes
+
+
 class _Failure:
     def __init__(self, err: str):
         self.err = err
@@ -397,16 +426,34 @@ _END = _EndMarker()
 
 
 def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
-                 emit_uint8, stop=None, deterministic=False):
+                 emit_uint8, stop=None, deterministic=False, widx=0):
     from .preprocessing import (RGB_MEANS, eval_crop_from_bytes,
                                 train_crop_from_bytes)
     import queue as queue_mod
 
+    from ..telemetry.tracer import span
     from ..utils.metrics import input_stages
     wrng = np.random.RandomState(wseed)
     # decode counters flush in small groups: an input_stages.add per image
-    # would contend the registry lock across the whole decode pool
-    pend_n = pend_s = pend_b = 0
+    # would contend the registry lock across the whole decode pool (and a
+    # _StageDelta per image would double the result-queue traffic)
+    pend_n = 0
+    pend_s = pend_b = 0
+
+    def flush_counters():
+        """Thread mode: straight into the process registry. Process mode
+        (stop is None): our registry dies with this worker — ship the
+        delta to the parent over the result queue instead (merged into
+        the parent's input_stages; see imagenet_iterator.batches)."""
+        nonlocal pend_n, pend_s, pend_b
+        if not pend_n:
+            return
+        if stop is None:
+            out_q.put(_StageDelta(widx, pend_n, pend_s, pend_b))
+        else:
+            input_stages.add("decode", pend_s, items=pend_n, nbytes=pend_b)
+        pend_n = 0
+        pend_s = pend_b = 0
 
     def put_checked(item) -> bool:
         """Timed put in thread mode so `stop` is observed even on a FULL
@@ -437,6 +484,10 @@ def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
             except queue_mod.Empty:
                 continue
             if item is _END or isinstance(item, _EndMarker):
+                # counters BEFORE the _END marker: the parent stops
+                # consuming at the n-th _END, so a delta after ours could
+                # only be read by luck
+                flush_counters()
                 put_checked(_END)
                 return
             if deterministic:
@@ -450,37 +501,39 @@ def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
                 seq, (data, label) = None, item
                 rng = wrng
             t0 = time.perf_counter()
-            if is_train:
-                img = train_crop_from_bytes(data, rng, image_size,
-                                            use_native=native_decode)
-            else:
-                img = eval_crop_from_bytes(data, image_size,
-                                           use_native=native_decode)
-            if not emit_uint8:
-                img = img.astype(np.float32) / 255.0 - RGB_MEANS
-            # decode busy time (stage counters, utils/metrics.py) — worker
-            # PROCESSES report into their own process's registry, so only
-            # thread-mode decode is visible here (docs/input_pipeline.md)
+            with span("input.decode"):
+                if is_train:
+                    img = train_crop_from_bytes(data, rng, image_size,
+                                                use_native=native_decode)
+                else:
+                    img = eval_crop_from_bytes(data, image_size,
+                                               use_native=native_decode)
+                if not emit_uint8:
+                    img = img.astype(np.float32) / 255.0 - RGB_MEANS
+            # decode busy time (stage counters, utils/metrics.py); worker
+            # PROCESSES flush deltas to the parent (flush_counters)
             pend_n += 1
             pend_s += time.perf_counter() - t0
             pend_b += img.nbytes
             if pend_n >= 16:
-                input_stages.add("decode", pend_s, items=pend_n,
-                                 nbytes=pend_b)
-                pend_n = pend_s = pend_b = 0
+                flush_counters()
             out = (img, label) if seq is None else (seq, (img, label))
             if not put_checked(out):
                 return
     finally:
-        if pend_n:
-            input_stages.add("decode", pend_s, items=pend_n, nbytes=pend_b)
+        # thread mode only: a worker PROCESS's terminal flush would land
+        # AFTER its _END (already flushed there) and could race the
+        # parent's teardown drain
+        if stop is not None:
+            flush_counters()
 
 
 def _decode_worker(in_q, out_q, wseed, is_train, image_size, native_decode,
-                   emit_uint8, deterministic=False):
+                   emit_uint8, deterministic=False, widx=0):
     """Process-pool worker body (fork target)."""
     try:
         _decode_loop(in_q, out_q, wseed, is_train, image_size,
-                     native_decode, emit_uint8, deterministic=deterministic)
+                     native_decode, emit_uint8, deterministic=deterministic,
+                     widx=widx)
     except BaseException as e:  # pragma: no cover - transported to parent
         out_q.put(_Failure(repr(e)))

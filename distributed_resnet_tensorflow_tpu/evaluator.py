@@ -77,7 +77,10 @@ class Evaluator:
     def evaluate_checkpoint(self, step: int) -> Dict[str, float]:
         """Restore a specific checkpoint + run eval_batch_count batches
         (reference ran 50 × bs=100, resnet_cifar_eval.py:111-122)."""
-        self.trainer.state, _ = self.manager.restore(self.trainer.state, step)
+        from .telemetry.tracer import span
+        with span("restore", step=step):
+            self.trainer.state, _ = self.manager.restore(
+                self.trainer.state, step)
         try:
             result = self.trainer.evaluate(self._iter(),
                                            self.cfg.eval.eval_batch_count)

@@ -36,15 +36,28 @@ from .resilience.preemption import (collective_preempted,
                                     collective_should_stop)
 from .resilience.faultinject import maybe_wrap_from_env
 from .resilience.sentinel import train_with_nan_recovery
-from .train.hooks import (CheckpointHook, CorruptRecordsHook, HeartbeatHook,
-                          InputStagesHook, LoggingHook, NanGuardHook,
-                          SummaryHook)
+from .telemetry import configure_from_config as _configure_telemetry
+from .telemetry.tracer import recorder as _flight_recorder
+from .train.hooks import (CheckpointHook, CorruptRecordsHook, GoodputHook,
+                          HeartbeatHook, InputStagesHook, LoggingHook,
+                          NanGuardHook, SummaryHook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
                            resolve_checkpoint_dir, stacked_layout_stamp)
 from .utils.metrics import MetricsWriter
 
 log = logging.getLogger(__name__)
+
+
+def _make_writer(cfg: ExperimentConfig, sub: str) -> MetricsWriter:
+    """The run's metrics stream, size-bounded per the telemetry knobs
+    (utils/metrics.MetricsWriter rotation): one construction site so every
+    mode gets the same disk bound."""
+    t = cfg.telemetry
+    return MetricsWriter(
+        os.path.join(cfg.log_root, sub),
+        max_bytes=int(t.metrics_max_mb * 1024 * 1024),
+        max_segments=t.metrics_max_segments)
 
 
 def _per_process_batch(global_bs: int, nproc: int) -> int:
@@ -338,7 +351,9 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
 
     start_step = 0
     if cfg.checkpoint.resume:
-        trainer.state, restored = manager.restore(trainer.state)
+        from .telemetry.tracer import span
+        with span("restore"):
+            trainer.state, restored = manager.restore(trainer.state)
         if restored is not None:
             start_step = int(trainer.state.step)
             log.info("resumed from checkpoint at step %d", start_step)
@@ -351,12 +366,15 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     writer = None
     step_flops = None
     if is_chief():
-        writer = MetricsWriter(os.path.join(cfg.log_root, "train"))
+        writer = _make_writer(cfg, "train")
         first, data_iter = _peek(data_iter)
         if first is not None:
             _write_input_grid(writer, first, trainer)
             if cfg.train.log_mfu:
                 step_flops = trainer.step_flops(first)
+    # flight recorder + goodput (telemetry/): dump dir, ring bound, the
+    # chief's writer for trace_dump/goodput rows; every process records
+    _configure_telemetry(cfg, writer, jax.process_index())
 
     guard_every = res.nan_check_every_steps or max(cfg.train.log_every_steps, 1)
     hooks = [NanGuardHook(every_steps=guard_every)]
@@ -369,6 +387,15 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         hooks.append(InputStagesHook(writer, cfg.train.summary_every_steps))
         # corrupt-TFRecord tally (data.max_corrupt_records skips) likewise
         hooks.append(CorruptRecordsHook(writer, cfg.train.summary_every_steps))
+        # goodput break-down (telemetry/goodput.py): compute vs input_wait
+        # vs checkpoint vs eval vs stall vs restart, per interval. Gated
+        # on the tracer: with spans off nothing charges the measured
+        # buckets and every row would read compute=100% — wrong data is
+        # worse than none
+        if cfg.telemetry.enabled:
+            hooks.append(GoodputHook(writer,
+                                     cfg.telemetry.goodput_every_steps
+                                     or cfg.train.summary_every_steps))
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
@@ -462,7 +489,8 @@ def run_eval(cfg: ExperimentConfig, max_evals: Optional[int] = None,
              timeout_secs: float = 0.0):
     writer = None
     if is_chief():
-        writer = MetricsWriter(os.path.join(cfg.log_root, "eval"))
+        writer = _make_writer(cfg, "eval")
+    _configure_telemetry(cfg, writer, jax.process_index())
     try:
         with _watchdog_session(cfg, writer, None, role="eval") \
                 as (publisher, watchdog):
@@ -497,7 +525,8 @@ def run_serve(cfg: ExperimentConfig):
     from .serve.server import InferenceServer
 
     serve_dir = os.path.join(cfg.log_root, "serve")
-    writer = MetricsWriter(serve_dir) if is_chief() else None
+    writer = _make_writer(cfg, "serve") if is_chief() else None
+    _configure_telemetry(cfg, writer, jax.process_index())
     server = InferenceServer(cfg, writer=writer)
     load = None
     try:
@@ -578,7 +607,8 @@ def run_train_and_eval(cfg: ExperimentConfig):
     if cfg.checkpoint.resume:
         trainer.state, _ = manager.restore(trainer.state)
 
-    writer = MetricsWriter(os.path.join(cfg.log_root, "train")) if is_chief() else None
+    writer = _make_writer(cfg, "train") if is_chief() else None
+    _configure_telemetry(cfg, writer, jax.process_index())
     # detection-only NaN guard (raises; the rollback sentinel is a
     # run_train capability — docs/resilience.md): dying loudly still beats
     # training and checkpointing NaN state to train_steps
@@ -597,6 +627,10 @@ def run_train_and_eval(cfg: ExperimentConfig):
             # visible in telemetry in every training mode
             hooks.append(CorruptRecordsHook(writer,
                                             cfg.train.summary_every_steps))
+            if cfg.telemetry.enabled:  # see run_train: no spans, no rows
+                hooks.append(GoodputHook(
+                    writer, cfg.telemetry.goodput_every_steps
+                    or cfg.train.summary_every_steps))
 
     train_iter = _make_train_source(cfg, trainer)
 
@@ -679,6 +713,12 @@ def main(argv=None):
         # virtual CPU mesh — no cluster, no data (docs/static_analysis.md)
         from .analysis.cli import main_check
         sys.exit(main_check(argv[1:]))
+    if argv and argv[0] == "monitor":
+        # cluster rollup (telemetry/monitor.py, docs/observability.md):
+        # tails every metrics stream + heartbeat file under a log_root —
+        # pure filesystem reads, no jax world, safe beside a live run
+        from .telemetry.monitor import main_monitor
+        sys.exit(main_monitor(argv[1:]))
     serve_cmd = False
     if argv and argv[0] == "serve":
         # inference server (serve/, docs/serving.md): same flags as the
@@ -719,7 +759,11 @@ def main(argv=None):
         # 75 = checkpoint committed, relaunch to resume
         log.info("%s", p)
         sys.exit(RESUMABLE_EXIT_CODE)
-    except Exception:
+    except Exception as e:
+        # non-zero exit: leave the flight-recorder dump next to the run —
+        # the post-mortem's first stop (telemetry/tracer.py; never raises)
+        _flight_recorder.dump_on_anomaly(
+            "exception", f"{type(e).__name__}: {e}"[:300])
         if jax.process_count() > 1:
             # a real failure with peers still alive: the run published a
             # final phase="failed" beat (peers stop through their

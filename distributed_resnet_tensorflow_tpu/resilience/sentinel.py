@@ -19,6 +19,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, Iterator, Optional, Tuple
 
+from ..telemetry.tracer import span
 from ..train.hooks import NanGuardHook
 
 log = logging.getLogger(__name__)
@@ -68,13 +69,16 @@ def train_with_nan_recovery(
                     f"rollback(s) with LR backed off to "
                     f"{lr_backoff ** max_strikes:g}x — giving up: {e}"
                 ) from e
-            trainer.state, restored = manager.restore(trainer.state)
-            if restored is None:
-                # nothing ever committed: restart from a fresh init
-                trainer.init_state()
-                step = 0
-            else:
-                step = int(trainer.state.step)
+            # goodput: rollback-recovery wall is "restart", not compute
+            # (telemetry/goodput.py)
+            with span("restore", category="restart"):
+                trainer.state, restored = manager.restore(trainer.state)
+                if restored is None:
+                    # nothing ever committed: restart from a fresh init
+                    trainer.init_state()
+                    step = 0
+                else:
+                    step = int(trainer.state.step)
             # rewind every hook's cadence to the restored step: a guard
             # whose _last still points at the trip step would be blind for
             # the whole replayed span — long enough for a cadence save to

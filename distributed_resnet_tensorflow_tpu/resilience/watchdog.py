@@ -108,6 +108,9 @@ class Watchdog:
         # straggler accounting: pid -> deque[(wall_time, step)]
         self._history: Dict[int, deque] = {}
         self._last_export = self._clock()
+        # flight-recorder: one automatic dump per straggler episode — a
+        # host flapping around the ratio must not dump every window
+        self._straggler_dumped = False
         # peer-loss only needs peer_timeout_secs granularity, so the
         # shared-FS beat scan (N opens per poll; O(N^2) fleet-wide) runs at
         # a fraction of the timeout instead of every tick — only the
@@ -250,11 +253,30 @@ class Watchdog:
                   kind, detail, code, self.cfg.grace_secs)
         self._write_event(kind, {"detail": detail, "exit_code": code,
                                  "grace_secs": self.cfg.grace_secs})
+        # flight recorder: dump the span ring NOW, from this (daemon)
+        # thread, while the wedged state is still in memory — the whole
+        # reason the recorder exists (telemetry/tracer.py). A hang's dead
+        # time is also charged to the goodput "stall" bucket so the
+        # breakdown reflects the incident, not just the logs.
+        self._flight_record(kind, detail)
         if self.request_stop is not None:
             try:
                 self.request_stop(kind)
             except Exception:  # pragma: no cover - stop path best effort
                 log.exception("watchdog: graceful stop request failed")
+
+    def _flight_record(self, kind: str, detail: str) -> None:
+        try:
+            from ..telemetry.tracer import recorder
+            if kind == "hang":
+                from ..telemetry.goodput import goodput
+                snap = self.publisher.snapshot()
+                goodput.add("stall",
+                            max(0.0, self._clock()
+                                - snap["last_progress_t"]))
+            recorder.dump_on_anomaly(kind, detail)
+        except Exception:  # pragma: no cover - observability best effort
+            log.exception("watchdog: flight-recorder dump failed")
 
     def _fresh_verdict(self, kind: str, code: int, detail: str,
                        peers: Dict[int, Beat], now: float) -> Optional[tuple]:
@@ -345,6 +367,9 @@ class Watchdog:
                 self._write_event(verdict[0], {
                     "detail": verdict[2], "exit_code": verdict[1],
                     "via": "collective_error"})
+                # this path bypasses _escalate (the verdict came from the
+                # main thread's exception) — the dump must still happen
+                self._flight_record(verdict[0], verdict[2])
                 return verdict
             if self._clock() >= deadline:
                 return None
@@ -397,6 +422,17 @@ class Watchdog:
                 "watchdog: process %d is a straggler: %.2f steps/s vs "
                 "median %.2f over the last %.0fs window", pid, rates[pid],
                 median, self.cfg.straggler_window_secs)
+        if flagged and not self._straggler_dumped:
+            # straggler ESCALATION (first flag of the run): leave a
+            # flight-recorder dump so "why is host 3 slow" starts from
+            # what its threads were doing, not from a re-run
+            self._straggler_dumped = True
+            self._flight_record(
+                "straggler",
+                f"processes {flagged} slower than median by >= "
+                f"{self.cfg.straggler_ratio}x")
+        elif not flagged:
+            self._straggler_dumped = False  # episode over; re-arm
         self._write_event("straggler", {
             "window_secs": self.cfg.straggler_window_secs,
             "rates": {str(pid): round(r, 4) for pid, r in sorted(rates.items())},

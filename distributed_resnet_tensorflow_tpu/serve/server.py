@@ -28,6 +28,7 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
+from ..telemetry.tracer import span
 from ..train.loop import Trainer
 from ..utils.config import ExperimentConfig, resolve_checkpoint_dir
 from ..utils.metrics import LatencyStats, MetricsWriter
@@ -165,12 +166,14 @@ class InferenceServer:
         from ..parallel.sharding import finalize_staged
         t0 = time.perf_counter()
         bucket = images.shape[0]
-        compiled = self.cache.get(bucket, self.image_shape, self.image_dtype)
-        # the Trainer's put path: CoalescedStager on accelerators (one
-        # batched transfer issue), per-leaf device_put fallback on CPU;
-        # finalize (a multi-device execution) stays on THIS thread
-        dev = finalize_staged(self.trainer._put_batch({"images": images}))
-        logits = np.asarray(compiled(self._state, dev))
+        with span("serve.batch", bucket=bucket, n=len(group)):
+            compiled = self.cache.get(bucket, self.image_shape,
+                                      self.image_dtype)
+            # the Trainer's put path: CoalescedStager on accelerators (one
+            # batched transfer issue), per-leaf device_put fallback on CPU;
+            # finalize (a multi-device execution) stays on THIS thread
+            dev = finalize_staged(self.trainer._put_batch({"images": images}))
+            logits = np.asarray(compiled(self._state, dev))
         t1 = time.perf_counter()
         step = self.serving_step
         key = f"bucket_{bucket}"
@@ -193,6 +196,10 @@ class InferenceServer:
             self._apply_swap(pending)
 
     def _apply_swap(self, pending: PendingSwap) -> None:
+        with span("serve.swap_apply", step=pending.step):
+            self._apply_swap_inner(pending)
+
+    def _apply_swap_inner(self, pending: PendingSwap) -> None:
         from ..parallel.sharding import put_to_sharding
         t0 = time.perf_counter()
         live = self._state

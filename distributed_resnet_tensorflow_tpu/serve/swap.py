@@ -31,6 +31,7 @@ import numpy as np
 
 from ..resilience.manifest import (committed_steps, manifest_digest,
                                    manifest_status)
+from ..telemetry.tracer import span
 
 log = logging.getLogger(__name__)
 
@@ -121,6 +122,11 @@ class CheckpointSwapper:
                    digest: str) -> Optional[PendingSwap]:
         """Verify + host-restore one committed step; parks (and returns)
         the PendingSwap, or records the rejection and returns None."""
+        with span("serve.swap_restore", step=step):
+            return self._load_step_inner(step, step_dir, digest)
+
+    def _load_step_inner(self, step: int, step_dir: str,
+                         digest: str) -> Optional[PendingSwap]:
         t0 = time.perf_counter()
         status, detail = manifest_status(step_dir)
         if status == "bad":

@@ -1,0 +1,298 @@
+"""Cluster rollup: ``main.py monitor`` — what is the whole run doing NOW.
+
+The per-process observability (metrics.jsonl event streams, heartbeat
+files, flight-recorder dumps) answers post-mortem questions; an operator
+mid-run needs the live aggregate: steps/s, goodput %, per-host skew, the
+last committed checkpoint, serving QPS/p99. This module tails every
+``metrics.jsonl`` stream under a root directory (the same shared-directory
+layout the heartbeat transport and checkpoint manager already use — one
+``log_root`` per host, or one shared one), merges the newest rows, and
+renders either a live text dashboard or a machine-readable JSON blob:
+
+    python -m distributed_resnet_tensorflow_tpu.main monitor --root /runs/r1
+    python -m distributed_resnet_tensorflow_tpu.main monitor --root /runs/r1 \
+        --once --json        # scripts / CI
+
+Reads are tolerant by construction: a stream mid-rotation, a torn JSON
+line, or a vanished heartbeat file degrade to "unknown", never to a crash —
+the monitor must keep rendering exactly when the run is sickest.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+#: how much of each live stream one monitor frame reads. Every lookup the
+#: rollup makes is "newest row of kind X" plus one rate pair — a bounded
+#: tail covers them all, and a full-stream parse would make each refresh
+#: frame of a week-long rotated run (GBs across segments) re-read
+#: everything on the very filesystem the run depends on.
+_TAIL_BYTES = 2 * 1024 * 1024
+
+
+def _read_rows(stream_dir: str, tail_bytes: int = _TAIL_BYTES) -> List[dict]:
+    """The newest rows of one metrics stream: the live file's last
+    ``tail_bytes`` (partial first line dropped), prefixed by the newest
+    rotated segment's tail when the live file is freshly rotated (so
+    rates survive a rotation boundary). Torn lines skipped."""
+    path = os.path.join(stream_dir, "metrics.jsonl")
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return []
+    paths = [(path, tail_bytes)]
+    if size < tail_bytes // 8 and os.path.exists(path + ".1"):
+        paths.insert(0, (path + ".1", tail_bytes // 4))
+    rows: List[dict] = []
+    for p, budget in paths:
+        try:
+            with open(p, "rb") as f:
+                psize = os.fstat(f.fileno()).st_size
+                if psize > budget:
+                    f.seek(psize - budget)
+                    f.readline()  # drop the partial first line
+                data = f.read()
+        except OSError:
+            continue
+        for line in data.decode("utf-8", errors="replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue  # torn mid-write; the stream is live
+    return rows
+
+
+def _last(rows: List[dict], event: Optional[str]) -> Optional[dict]:
+    """Newest row of a kind: ``event=None`` = newest scalar row."""
+    for row in reversed(rows):
+        if event is None and "event" not in row and "step" in row:
+            return row
+        if event is not None and row.get("event") == event:
+            return row
+    return None
+
+
+def _steps_per_sec(rows: List[dict]) -> Optional[float]:
+    """Rate from the two newest scalar rows (cadence-spaced, so this is a
+    window estimate, not an instantaneous one)."""
+    scalars = [r for r in rows if "event" not in r and "step" in r
+               and "time" in r]
+    if len(scalars) < 2:
+        return None
+    a, b = scalars[-2], scalars[-1]
+    dt = b["time"] - a["time"]
+    ds = b["step"] - a["step"]
+    if dt <= 0 or ds <= 0:
+        return None
+    return ds / dt
+
+
+def summarize_stream(stream_dir: str, now: Optional[float] = None) -> dict:
+    """One stream's rollup (a stream = one directory holding
+    metrics.jsonl, e.g. ``<log_root>/train``)."""
+    now = time.time() if now is None else now
+    rows = _read_rows(stream_dir)
+    out: dict = {"rows": len(rows)}
+    scalar = _last(rows, None)
+    if scalar is not None:
+        out["step"] = int(scalar["step"])
+        out["age_secs"] = round(now - scalar["time"], 1)
+        for key in ("loss", "precision", "eval/precision"):
+            if key in scalar:
+                out[key.replace("/", "_")] = round(float(scalar[key]), 4)
+    rate = _steps_per_sec(rows)
+    if rate is not None:
+        out["steps_per_sec"] = round(rate, 3)
+    gp = _last(rows, "goodput")
+    if gp is not None and "pct" in gp:
+        out["goodput_pct"] = gp["pct"].get("compute")
+        out["goodput"] = gp["pct"]
+    strag = _last(rows, "straggler")
+    if strag is not None:
+        out["lag_steps"] = strag.get("lag_steps")
+        out["stragglers_flagged"] = strag.get("flagged")
+    hb = _last(rows, "heartbeat")
+    if hb is not None:
+        out["heartbeat_hosts"] = {
+            pid: {"step": h.get("step"), "phase": h.get("phase"),
+                  "host": h.get("host")}
+            for pid, h in (hb.get("hosts") or {}).items()}
+    sr = _last(rows, "serve_request")
+    if sr is not None:
+        out["serve"] = {"requests": sr.get("requests"),
+                        "dropped": sr.get("dropped"),
+                        "buckets": sr.get("buckets")}
+    sb = _last(rows, "serve_batch")
+    if sb is not None:
+        out.setdefault("serve", {})["last_batch"] = {
+            "bucket": sb.get("bucket"), "n": sb.get("n"),
+            "run_ms": sb.get("run_ms")}
+    dump = _last(rows, "trace_dump")
+    if dump is not None:
+        out["trace_dump"] = {"reason": dump.get("reason"),
+                             "path": dump.get("path")}
+    cr = _last(rows, "corrupt_record")
+    if cr is not None:
+        out["corrupt_records"] = cr.get("count")
+    return out
+
+
+def _beat_files(root: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(root, "**", "proc*.json"),
+                            recursive=True))
+
+
+def _read_beats(root: str, now: float) -> Dict[str, dict]:
+    """Per-process latest beat across every heartbeat dir under root —
+    the same files resilience/heartbeat.FileBeatTransport exchanges."""
+    out: Dict[str, dict] = {}
+    for path in _beat_files(root):
+        if "heartbeats" not in os.path.dirname(path):
+            continue
+        try:
+            with open(path) as f:
+                beat = json.load(f)
+        except (OSError, ValueError):
+            continue
+        pid = str(beat.get("process_id", "?"))
+        prev = out.get(pid)
+        if prev is None or beat.get("wall_time", 0) > prev.get("wall_time", 0):
+            beat["age_secs"] = round(now - beat.get("wall_time", now), 1)
+            out[pid] = beat
+    return out
+
+
+def _checkpoint_step(root: str) -> Optional[int]:
+    """Newest committed step of any ``ckpt`` directory under root."""
+    from ..resilience.manifest import committed_steps
+    newest: Optional[int] = None
+    for d in glob.glob(os.path.join(root, "**", "ckpt"), recursive=True) \
+            + [os.path.join(root, "ckpt")]:
+        try:
+            steps = committed_steps(d)
+        except OSError:
+            continue
+        if steps:
+            newest = steps[-1] if newest is None else max(newest, steps[-1])
+    return newest
+
+
+def aggregate(root: str, now: Optional[float] = None) -> dict:
+    """The whole-run rollup: every metrics stream under ``root``, the
+    heartbeat fleet, the newest committed checkpoint."""
+    now = time.time() if now is None else now
+    root = os.path.abspath(root)
+    streams: Dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(root, "**", "metrics.jsonl"),
+                                 recursive=True)
+                       + glob.glob(os.path.join(root, "metrics.jsonl"))):
+        d = os.path.dirname(path)
+        rel = os.path.relpath(d, root)
+        if rel in streams:
+            continue
+        streams[rel] = summarize_stream(d, now=now)
+    beats = _read_beats(root, now)
+    out: dict = {"root": root, "time": now, "streams": streams}
+    if beats:
+        out["hosts"] = beats
+        steps = [b.get("step", 0) for b in beats.values()]
+        if steps:
+            out["host_step_skew"] = max(steps) - min(steps)
+        stale = [pid for pid, b in beats.items()
+                 if b.get("age_secs", 0) > 60
+                 and b.get("phase") not in ("done", "preempted", "failed")]
+        if stale:
+            out["stale_hosts"] = stale
+    ckpt = _checkpoint_step(root)
+    if ckpt is not None:
+        out["last_committed_step"] = ckpt
+    # headline: the fastest train-shaped stream is the chief's
+    rates = {name: s["steps_per_sec"] for name, s in streams.items()
+             if "steps_per_sec" in s}
+    if rates:
+        lead = max(rates, key=rates.get)
+        out["steps_per_sec"] = rates[lead]
+        out["lead_stream"] = lead
+    for name, s in streams.items():
+        if "goodput" in s:
+            out.setdefault("goodput", s["goodput"])
+            break
+    return out
+
+
+def render(agg: dict) -> str:
+    """Human-readable dashboard frame."""
+    lines = [f"== drt monitor :: {agg['root']} :: "
+             f"{time.strftime('%H:%M:%S', time.localtime(agg['time']))} =="]
+    if "steps_per_sec" in agg:
+        lines.append(f"  steps/s: {agg['steps_per_sec']:.3f} "
+                     f"({agg.get('lead_stream')})")
+    if "goodput" in agg:
+        gp = agg["goodput"]
+        lines.append("  goodput: " + "  ".join(
+            f"{c} {gp.get(c, 0):.1f}%" for c in
+            ("compute", "input_wait", "checkpoint", "eval", "stall",
+             "restart") if gp.get(c)))
+    if "last_committed_step" in agg:
+        lines.append(f"  checkpoint: step {agg['last_committed_step']} "
+                     "committed")
+    if "hosts" in agg:
+        lines.append(f"  hosts ({len(agg['hosts'])}; "
+                     f"skew {agg.get('host_step_skew', 0)} steps):")
+        for pid, b in sorted(agg["hosts"].items()):
+            lines.append(
+                f"    proc{pid} {b.get('host', '?')}: step "
+                f"{b.get('step', '?')} phase {b.get('phase', '?')} "
+                f"(beat {b.get('age_secs', '?')}s ago)")
+    if agg.get("stale_hosts"):
+        lines.append(f"  !! stale hosts: {agg['stale_hosts']}")
+    for name, s in sorted(agg["streams"].items()):
+        bits = [f"  [{name}]"]
+        if "step" in s:
+            bits.append(f"step {s['step']}")
+        if "steps_per_sec" in s:
+            bits.append(f"{s['steps_per_sec']:.3f} st/s")
+        for k in ("loss", "precision", "eval_precision"):
+            if k in s:
+                bits.append(f"{k} {s[k]}")
+        if "serve" in s:
+            srv = s["serve"]
+            bits.append(f"serve req {srv.get('requests')} "
+                        f"dropped {srv.get('dropped')}")
+        if "trace_dump" in s:
+            bits.append(f"TRACE DUMPED ({s['trace_dump'].get('reason')})")
+        if "corrupt_records" in s:
+            bits.append(f"corrupt_records {s['corrupt_records']}")
+        lines.append(" ".join(bits))
+    return "\n".join(lines)
+
+
+def main_monitor(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="main.py monitor",
+        description="live cluster rollup over a run's log_root")
+    ap.add_argument("--root", default="/tmp/drt_tpu",
+                    help="the run's log_root (shared directory)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON instead of text")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="refresh cadence in seconds (live mode)")
+    ns = ap.parse_args(argv)
+    try:
+        while True:
+            agg = aggregate(ns.root)
+            print(json.dumps(agg) if ns.json else render(agg), flush=True)
+            if ns.once:
+                return 0
+            time.sleep(max(0.2, ns.interval))
+    except KeyboardInterrupt:
+        return 0

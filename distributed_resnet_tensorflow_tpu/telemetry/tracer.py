@@ -1,0 +1,326 @@
+"""Flight-recorder span tracer: low-overhead per-thread spans in a ring.
+
+The reference's only answer to "where did the wall-clock go" was offline log
+scraping (SURVEY.md §2.15, §5); Horovod shipped a timeline tracer precisely
+because distributed step-time mysteries cannot be debugged from scalars
+(arXiv:1802.05799). This module is that capability for the framework:
+
+  * ``span("input.wait")`` — a context manager recording one timed event
+    per use into a BOUNDED in-memory ring (a crashed/wedged run holds the
+    last ~N events per process, like an aircraft flight recorder). The hot
+    path is two ``perf_counter`` reads plus one locked deque append — cheap
+    enough to leave on in production (the bench acceptance bar is <2% on
+    the CIFAR headline).
+  * ``FlightRecorder.dump()`` — serialize the ring as a Chrome-trace /
+    Perfetto ``trace.json`` (``{"traceEvents": [...]}``, complete "X"
+    events with per-thread lanes and thread-name metadata), atomically.
+  * ``dump_on_anomaly()`` — the watchdog's hook (resilience/watchdog.py):
+    when a hang / peer-loss escalation or a straggler flag fires, the ring
+    dumps automatically and a ``{"event": "trace_dump"}`` row lands in
+    metrics.jsonl, so the post-mortem starts with "what was each thread
+    doing", not with reproducing the hang. Optionally brackets an
+    on-demand ``jax.profiler`` window (utils/profiling.trace_window) for
+    device-side visibility too.
+
+Spans may carry a goodput ``category`` (telemetry/goodput.py): the span's
+duration is charged to that category on exit, so ONE instrumentation site
+feeds both the flight recorder and the goodput accounting. Nested
+categorized spans charge only the outermost one (per thread) — an
+``eval.batch`` inside an ``eval.round`` must not double-count.
+
+Span names are REGISTERED in :data:`SPAN_CATALOG` — the same drift
+contract as ``utils.metrics.EVENT_SCHEMAS``: the registry-drift lint rule
+(analysis/rules/registry_drift.py) resolves every ``span("<name>")``
+literal against the catalog, and unknown names warn once at runtime
+(observability must never kill a run). docs/observability.md is the
+operator-facing catalog.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+#: bump when the trace.json event shape changes (consumers key on it via
+#: the ``trace_dump`` metrics row and the file's otherData block)
+SPAN_SCHEMA_VERSION = 1
+
+#: every span name the framework emits — register HERE first (the
+#: registry-drift rule rejects unregistered ``span("...")`` literals, the
+#: runtime warns once). Value = one-line description for the docs.
+SPAN_CATALOG = {
+    # input pipeline (data/device_prefetch.py, data/imagenet.py)
+    "input.decode": "one image decoded + cropped (decode worker thread)",
+    "input.stack": "K host batches drawn + np.stack'ed (stacker thread)",
+    "input.stage": "host batch packed/staged by the put path (staging "
+                   "thread; CoalescedStager pack + issue)",
+    "input.transfer": "wait for the previous batch's H2D transfer to "
+                      "complete (staging thread)",
+    "input.wait": "train loop blocked waiting for the next device batch "
+                  "(goodput: input_wait)",
+    # train loop (train/loop.py)
+    "train.step": "one optimizer-step (or fused K-step) dispatch",
+    "eval.round": "one full evaluation round (goodput: eval)",
+    "eval.batch": "one eval batch: stage wait + step dispatch",
+    # checkpointing (checkpoint/manager.py)
+    "checkpoint.save": "save() on the step-loop thread: host snapshot + "
+                       "handoff (async) or the full write (sync) "
+                       "(goodput: checkpoint)",
+    "checkpoint.wait": "step-loop thread blocked on an in-flight async "
+                       "save (goodput: checkpoint)",
+    "checkpoint.stage": "orbax serialization into the staging dir "
+                        "(writer thread when async)",
+    "checkpoint.fsync": "manifest write + fsync",
+    "checkpoint.commit": "atomic rename + parent-dir fsync",
+    "restore": "checkpoint restore into the live state (goodput: restart "
+               "when on the NaN-rollback path)",
+    # serving (serve/server.py, serve/swap.py)
+    "serve.batch": "one bucket dispatch: stage + AOT predict + resolve",
+    "serve.swap_restore": "off-path host restore of a newer checkpoint",
+    "serve.swap_apply": "atomic param swap at a batch boundary",
+}
+
+# unknown span names already warned about (warn once, like write_event)
+_UNKNOWN_SPANS_WARNED: set = set()
+
+
+class _NoopSpan:
+    """Shared do-nothing span for a disabled recorder."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_rec", "name", "category", "args", "_t0", "_counted")
+
+    def __init__(self, rec: "FlightRecorder", name: str,
+                 category: Optional[str], args: Optional[dict]):
+        self._rec = rec
+        self.name = name
+        self.category = category
+        self.args = args
+
+    def __enter__(self):
+        self._counted = False
+        if self.category is not None:
+            # outermost-categorized-span guard (see module docstring):
+            # only spans that carried a category touch the depth counter
+            local = self._rec._local
+            depth = getattr(local, "cat_depth", 0)
+            local.cat_depth = depth + 1
+            self._counted = True
+            if depth:
+                self.category = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        rec = self._rec
+        dur = t1 - self._t0
+        tid = threading.get_ident()
+        if tid not in rec._thread_names:
+            rec._thread_names[tid] = threading.current_thread().name
+        with rec._lock:
+            rec._events.append((self.name, tid, self._t0, dur, self.args))
+        if self._counted:
+            rec._local.cat_depth -= 1
+        if self.category is not None:
+            from .goodput import goodput
+            goodput.add(self.category, dur)
+        return False
+
+
+class FlightRecorder:
+    """The process-global bounded span ring + dump machinery.
+
+    ``configure()`` is called once per entry point (main.py) with the run's
+    dump directory and (chief-only) metrics writer; until then spans still
+    record — only automatic dumps need the configuration.
+    """
+
+    def __init__(self, ring: int = 65536, enabled: bool = True):
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(maxlen=ring)
+        self._thread_names: Dict[int, str] = {}
+        self._local = threading.local()
+        self._enabled = enabled
+        self._epoch_perf = time.perf_counter()
+        self._epoch_wall = time.time()
+        self._dump_dir: Optional[str] = None
+        self._writer = None
+        self._process_index = 0
+        self._profile_on_anomaly = False
+        self._profile_secs = 5.0
+        self._profiled = False
+
+    # -- configuration ------------------------------------------------------
+    def configure(self, dump_dir: Optional[str] = None, writer=None,
+                  ring: Optional[int] = None,
+                  enabled: Optional[bool] = None,
+                  process_index: Optional[int] = None,
+                  profile_on_anomaly: Optional[bool] = None,
+                  profile_secs: Optional[float] = None) -> None:
+        if ring is not None and ring != self._events.maxlen:
+            with self._lock:
+                self._events = collections.deque(self._events, maxlen=ring)
+        if enabled is not None:
+            self._enabled = enabled
+        if dump_dir is not None:
+            self._dump_dir = dump_dir
+        if writer is not None:
+            self._writer = writer
+        if process_index is not None:
+            self._process_index = process_index
+        if profile_on_anomaly is not None:
+            self._profile_on_anomaly = profile_on_anomaly
+        if profile_secs is not None:
+            self._profile_secs = profile_secs
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    # -- recording ----------------------------------------------------------
+    def span(self, name: str, category: Optional[str] = None, **args):
+        """Context manager timing one event. ``category`` charges the
+        duration to the goodput meter (outermost categorized span per
+        thread only); ``**args`` ride into the trace event (keep them off
+        hot paths — the dict allocation is the cost)."""
+        if not self._enabled:
+            return _NOOP
+        if name not in SPAN_CATALOG and name not in _UNKNOWN_SPANS_WARNED:
+            _UNKNOWN_SPANS_WARNED.add(name)
+            log.warning(
+                "span %r is not declared in telemetry.tracer.SPAN_CATALOG "
+                "— register it (the registry-drift lint rejects "
+                "undeclared literals)", name)
+        return _Span(self, name, category, args or None)
+
+    # -- dumping ------------------------------------------------------------
+    def trace_events(self) -> list:
+        """The ring as Chrome-trace event dicts (ts/dur in microseconds,
+        relative to the recorder epoch)."""
+        with self._lock:
+            snap = list(self._events)
+        names = dict(self._thread_names)
+        pid = os.getpid()
+        events = [
+            {"name": f"thread: {tname}", "ph": "M", "pid": pid, "tid": tid,
+             "ts": 0, "cat": "__metadata", "args": {"name": tname}}
+            for tid, tname in sorted(names.items())]
+        # Perfetto also honors the canonical thread_name metadata record
+        events += [
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "ts": 0, "args": {"name": tname}}
+            for tid, tname in sorted(names.items())]
+        for name, tid, t0, dur, args in snap:
+            ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
+                  "ts": round((t0 - self._epoch_perf) * 1e6, 3),
+                  "dur": round(dur * 1e6, 3), "cat": "span"}
+            if args:
+                ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+            events.append(ev)
+        return events
+
+    def default_dump_path(self) -> Optional[str]:
+        if self._dump_dir is None:
+            return None
+        name = "trace.json" if self._process_index == 0 \
+            else f"trace.proc{self._process_index}.json"
+        return os.path.join(self._dump_dir, name)
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "on_demand") -> Optional[str]:
+        """Write the ring as ``trace.json`` (atomic tmp+rename). Returns
+        the path written, or None when no path is known. Never raises —
+        the callers are crash/teardown paths."""
+        try:
+            path = path or self.default_dump_path()
+            if path is None:
+                return None
+            events = self.trace_events()
+            doc = {
+                "traceEvents": events,
+                "displayTimeUnit": "ms",
+                "otherData": {
+                    "span_schema_version": SPAN_SCHEMA_VERSION,
+                    "reason": reason,
+                    "process_index": self._process_index,
+                    "pid": os.getpid(),
+                    "epoch_wall_time": self._epoch_wall,
+                    "ring_capacity": self._events.maxlen,
+                },
+            }
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+            log.info("flight recorder: %d span(s) dumped to %s (%s)",
+                     sum(1 for e in events if e.get("ph") == "X"), path,
+                     reason)
+            return path
+        except Exception:  # a failed dump must not worsen the teardown
+            log.exception("flight recorder dump failed")
+            return None
+
+    def dump_on_anomaly(self, kind: str, detail: str = "") -> Optional[str]:
+        """The watchdog / fatal-exit hook: dump the ring, record a
+        ``trace_dump`` metrics row (chief), optionally bracket a
+        ``jax.profiler`` window (telemetry.profile_on_anomaly — once per
+        process: a flapping straggler must not profile in a loop)."""
+        path = self.dump(reason=kind)
+        if self._writer is not None:
+            try:
+                self._writer.write_event("trace_dump", {
+                    "reason": kind, "detail": detail,
+                    "path": path or "",
+                    "spans": len(self._events),
+                    "span_schema_version": SPAN_SCHEMA_VERSION})
+                self._writer.flush()
+            except Exception:  # pragma: no cover - observability best effort
+                log.exception("trace_dump metrics row failed")
+        if self._profile_on_anomaly and not self._profiled \
+                and self._dump_dir is not None:
+            self._profiled = True
+            try:
+                from ..utils.profiling import trace_window
+                trace_window(os.path.join(self._dump_dir, "profile"),
+                             self._profile_secs)
+            except Exception:  # pragma: no cover - profiler best effort
+                log.exception("anomaly-triggered jax.profiler window failed")
+        return path
+
+
+def _jsonable(v: Any):
+    return v if isinstance(v, (str, int, float, bool, type(None))) else str(v)
+
+
+#: the process-global recorder every instrumentation site uses
+recorder = FlightRecorder()
+
+#: ``from ..telemetry import span`` — the one spelling the lint rule knows
+span = recorder.span

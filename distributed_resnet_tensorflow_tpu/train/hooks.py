@@ -139,6 +139,42 @@ class InputStagesHook(_CadenceHook):
                                     {"step": int(step), "stages": snap})
 
 
+class GoodputHook(_CadenceHook):
+    """Export the goodput classification (telemetry/goodput.py) to
+    metrics.jsonl as ``{"event": "goodput"}`` rows every N steps: per-
+    category seconds + percentages of the interval's wall clock, summing
+    to ~100% by construction (compute is the remainder). The break-down an
+    operator needs to know whether the cluster is training or waiting —
+    and the number ROADMAP items 2 and 5 are measured against."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+        self._based = False
+
+    def reset_window(self) -> None:
+        """Trainer.train calls this at every segment start; only the FIRST
+        rebases the meter (setup/restore wall before step 1 must not be
+        billed as compute). Later segment boundaries must NOT rebase: the
+        pause between segments is an eval round or a checkpoint — exactly
+        the wall time goodput exists to classify, unlike the throughput
+        window (LoggingHook) which rightly excludes it."""
+        if not self._based:
+            self._based = True
+            from ..telemetry.goodput import goodput
+            goodput.rebase()
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        from ..telemetry.goodput import goodput
+        itv = goodput.interval()
+        if itv["wall_secs"] > 0:
+            self.writer.write_event("goodput", {"step": int(step), **itv})
+
+
 class CorruptRecordsHook(_CadenceHook):
     """Export the corrupt-TFRecord tally (data/tfrecord.corrupt_records) to
     metrics.jsonl as ``{"event": "corrupt_record"}`` rows — one row per

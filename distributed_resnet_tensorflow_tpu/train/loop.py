@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import (batch_shard_count, create_mesh, data_sharding,
                              present_batch_axes, shard_map_compat)
+from ..telemetry.tracer import span
 from ..parallel.sharding import (finalize_staged, make_global_batch,
                                  shard_batch)
 from .optimizers import (create_optimizer, decoupled_decay,
@@ -692,12 +693,17 @@ class Trainer:
             dev_iter = self._dev_prefetch[1]
             for step in range(start_step, num_steps):
                 try:
-                    batch = next(dev_iter)
+                    # flight-recorder + goodput: time blocked on input
+                    # (telemetry/; the span is ~2 clock reads when enabled,
+                    # a shared no-op otherwise)
+                    with span("input.wait", category="input_wait"):
+                        batch = next(dev_iter)
                 except StopIteration:
                     # finite stream exhausted: end training cleanly, same
                     # contract as the fused k>1 path
                     return self.state, metrics
-                self.state, metrics = step_fn(self.state, batch)
+                with span("train.step"):
+                    self.state, metrics = step_fn(self.state, batch)
                 for h in hooks:
                     h(step + 1, self.state, metrics)
                 if stop_fn is not None and stop_fn():
@@ -739,7 +745,8 @@ class Trainer:
                 if stop_fn is not None and stop_fn():
                     return i - offset
                 b = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
-                self.state, metrics = step_fn(self.state, b)
+                with span("train.step"):
+                    self.state, metrics = step_fn(self.state, b)
                 step += 1
                 for h in hooks:
                     h(step, self.state, metrics)
@@ -762,10 +769,12 @@ class Trainer:
             if stop_fn is not None and stop_fn():
                 return self.state, metrics
             try:
-                stacked = next(stacked_iter)
+                with span("input.wait", category="input_wait"):
+                    stacked = next(stacked_iter)
             except StopIteration:
                 return self.state, metrics
-            self.state, metrics = multi_fn(self.state, stacked)
+            with span("train.step"):
+                self.state, metrics = multi_fn(self.state, stacked)
             step += k
             for h in hooks:
                 h(step, self.state, metrics)
@@ -823,34 +832,40 @@ class Trainer:
         # a per-batch int() would sync host<->device every eval step
         totals = None
         hb = self.heartbeat
+        # goodput: in-loop eval rounds are their own wall-clock bucket
+        # (telemetry/goodput.py); the per-batch spans nest inside this one
+        # and charge nothing extra (outermost-categorized-span rule)
         try:
-            for i in range(num_batches):
-                if hb is not None:
-                    # batch 0 carries the eval step's XLA compile, which
-                    # can legitimately exceed the hang deadline — keep it
-                    # in an unmonitored phase, exactly like the train
-                    # path's "init" (a mid-compile hard-exit 75 would
-                    # requeue-loop the job); monitoring arms at batch 1
-                    hb.tick(phase="eval_init" if i == 0 else "eval")
-                try:
-                    batch = next(dev_iter)
-                except StopIteration:
-                    # one-pass streams (ImageNet eval) can exhaust before
-                    # num_batches; single-process, return metrics over the
-                    # batches actually consumed. Multi-process we must NOT
-                    # break unilaterally — the other processes would block in
-                    # the next collective — so fail loudly instead.
-                    if jax.process_count() > 1:
-                        raise RuntimeError(
-                            "eval stream exhausted mid-evaluation on this "
-                            "process; with multiple processes this would "
-                            "deadlock the collective step — size "
-                            "eval_batch_count to the smallest per-process "
-                            "shard") from None
-                    break
-                out = step_fn(self.state, batch)
-                totals = out if totals is None else \
-                    jax.tree_util.tree_map(jnp.add, totals, out)
+            with span("eval.round", category="eval"):
+                for i in range(num_batches):
+                    if hb is not None:
+                        # batch 0 carries the eval step's XLA compile, which
+                        # can legitimately exceed the hang deadline — keep it
+                        # in an unmonitored phase, exactly like the train
+                        # path's "init" (a mid-compile hard-exit 75 would
+                        # requeue-loop the job); monitoring arms at batch 1
+                        hb.tick(phase="eval_init" if i == 0 else "eval")
+                    with span("eval.batch"):
+                        try:
+                            batch = next(dev_iter)
+                        except StopIteration:
+                            # one-pass streams (ImageNet eval) can exhaust
+                            # before num_batches; single-process, return
+                            # metrics over the batches actually consumed.
+                            # Multi-process we must NOT break unilaterally —
+                            # the other processes would block in the next
+                            # collective — so fail loudly instead.
+                            if jax.process_count() > 1:
+                                raise RuntimeError(
+                                    "eval stream exhausted mid-evaluation on "
+                                    "this process; with multiple processes "
+                                    "this would deadlock the collective step "
+                                    "— size eval_batch_count to the smallest "
+                                    "per-process shard") from None
+                            break
+                        out = step_fn(self.state, batch)
+                        totals = out if totals is None else \
+                            jax.tree_util.tree_map(jnp.add, totals, out)
         finally:
             # stop the staging thread (the caller keeps ownership of
             # data_iter itself — Evaluator reuses caller-supplied iterators)

@@ -307,6 +307,38 @@ class AnalysisConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Flight recorder + goodput accounting (telemetry/;
+    docs/observability.md). The reference's only observability was stdout
+    logs and TensorBoard scalars (SURVEY.md §2.15); these knobs control the
+    span tracer, its anomaly-triggered dumps, and the goodput export."""
+
+    # record spans into the bounded in-memory ring (telemetry/tracer.py).
+    # Measured negligible (<2% on the CIFAR headline — the bench acceptance
+    # bar), so on by default; off = every span is a shared no-op.
+    enabled: bool = True
+    # ring capacity in span events — the flight recorder's memory bound
+    # (~100 bytes/event; 65536 ≈ the last few minutes of a busy run)
+    ring_events: int = 65536
+    # where trace.json dumps land; empty = <log_root>/telemetry
+    trace_dir: str = ""
+    # goodput metrics-row cadence in steps; 0 = ride
+    # train.summary_every_steps
+    goodput_every_steps: int = 0
+    # when a watchdog anomaly fires, also bracket an on-demand
+    # jax.profiler window (utils/profiling.trace_window) of profile_secs
+    # into <trace_dir>/profile — device-side visibility at the price of
+    # profiler overhead during the incident; once per process
+    profile_on_anomaly: bool = False
+    profile_secs: float = 5.0
+    # metrics.jsonl size-triggered rotation (utils/metrics.MetricsWriter):
+    # rotate past this many MB, keep this many rotated segments. A
+    # week-long serve/monitor run must not fill the disk. 0 MB = unbounded
+    metrics_max_mb: float = 256.0
+    metrics_max_segments: int = 4
+
+
+@dataclass
 class EvalConfig:
     """Standalone polling evaluator (reference resnet_cifar_eval.py:85-141)."""
 
@@ -371,6 +403,7 @@ class ExperimentConfig:
     eval: EvalConfig = field(default_factory=EvalConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     mode: str = "train"               # train | eval | train_and_eval | serve
     log_root: str = "/tmp/drt_tpu"    # reference log_root flag
 

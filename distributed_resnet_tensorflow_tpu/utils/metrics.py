@@ -41,12 +41,18 @@ class StageStats:
 
     def __init__(self):
         self._lock = threading.Lock()
-        # (stage, thread_id) -> [count, items, seconds, bytes]
+        # (stage, worker_key) -> [count, items, seconds, bytes]
         self._cells: Dict[tuple, list] = {}
 
     def add(self, stage: str, seconds: float, items: int = 0,
-            nbytes: int = 0) -> None:
-        key = (stage, threading.get_ident())
+            nbytes: int = 0, worker=None) -> None:
+        """``worker`` overrides the default thread-identity cell key — the
+        merge path for counters that were accumulated in ANOTHER process
+        (imagenet decode worker processes ship snapshots back over their
+        result queue; the parent merges them here under a per-worker key so
+        ``max_thread_seconds`` still reflects the busiest worker, not the
+        merging thread)."""
+        key = (stage, threading.get_ident() if worker is None else worker)
         with self._lock:
             cell = self._cells.get(key)
             if cell is None:
@@ -90,9 +96,10 @@ class StageStats:
 # process-global input-pipeline telemetry: decode workers, the batch
 # stacker, the staging/transfer thread and the dispatch loop all feed this
 # one registry; InputStagesHook exports it to metrics.jsonl and bench.py
-# reads it for end-to-end attribution. NOTE: decode worker PROCESSES
-# (data.decode_processes > 0) report into their own process's registry —
-# their decode busy time is not visible here (docs/input_pipeline.md).
+# reads it for end-to-end attribution. Decode worker PROCESSES
+# (data.decode_processes > 0) accumulate in their own process and ship
+# counter snapshots back over the result queue; the parent merges them
+# here under per-worker keys (data/imagenet.py, docs/input_pipeline.md).
 input_stages = StageStats()
 
 
@@ -202,6 +209,31 @@ EVENT_SCHEMAS = {
             "run_ms": "dispatch -> logits-on-host wall time",
         },
     },
+    "goodput": {
+        "emitted_by": "train/hooks.py GoodputHook (summary cadence)",
+        "fields": {
+            "step": "step at export time",
+            "wall_secs": "wall seconds classified in this interval",
+            "seconds": "per-category seconds {compute, input_wait, "
+                       "checkpoint, eval, stall, restart} — compute is "
+                       "the interval remainder (telemetry/goodput.py)",
+            "pct": "per-category percentages; sum to ~100 of wall by "
+                   "construction",
+        },
+    },
+    "trace_dump": {
+        "emitted_by": "telemetry/tracer.py FlightRecorder.dump_on_anomaly "
+                      "(watchdog escalations, straggler flags, fatal "
+                      "exits)",
+        "fields": {
+            "reason": "what triggered the dump (hang | peer_lost | "
+                      "peer_failed | straggler | exception | on_demand)",
+            "detail": "human-readable trigger detail",
+            "path": "trace.json written (Chrome-trace / Perfetto format)",
+            "spans": "events in the ring at dump time",
+            "span_schema_version": "telemetry.tracer.SPAN_SCHEMA_VERSION",
+        },
+    },
     "serve_swap": {
         "emitted_by": "serve/server.py / serve/swap.py (hot checkpoint "
                       "swap)",
@@ -228,13 +260,26 @@ _UNKNOWN_EVENTS_WARNED: set = set()
 
 class MetricsWriter:
     """JSONL + optional TensorBoard scalar writer. Process-0-only by default
-    (matching chief-only summaries in the reference)."""
+    (matching chief-only summaries in the reference).
+
+    The JSONL stream is SIZE-BOUNDED: past ``max_bytes`` the file rotates
+    (atomic rename to ``metrics.jsonl.1``, older segments shifting up to
+    ``max_segments`` before the oldest is dropped) — a week-long serve or
+    monitor run cannot fill the disk with event rows. ``read_metrics``
+    reads rotated segments oldest-first, so consumers see one continuous
+    stream."""
 
     def __init__(self, logdir: str, enable_tensorboard: bool = True,
-                 filename: str = "metrics.jsonl"):
+                 filename: str = "metrics.jsonl",
+                 max_bytes: int = 256 * 1024 * 1024,
+                 max_segments: int = 4):
         self.logdir = logdir
         os.makedirs(logdir, exist_ok=True)
-        self._jsonl = open(os.path.join(logdir, filename), "a", buffering=1)
+        self._path = os.path.join(logdir, filename)
+        self._max_bytes = max(0, max_bytes)  # 0 = rotation off
+        self._max_segments = max(1, max_segments)
+        self._jsonl = open(self._path, "a", buffering=1)
+        self._size = self._jsonl.tell()  # append mode: position == size
         # the watchdog's detection thread writes events concurrently with
         # the hook thread's scalars; serialize so rows never interleave
         self._wlock = threading.Lock()
@@ -245,6 +290,37 @@ class MetricsWriter:
                 self._tb = SummaryWriter(logdir=logdir)
             except Exception:  # tensorboardX optional
                 log.info("tensorboardX unavailable; JSONL metrics only")
+
+    def _write_line(self, line: str) -> None:
+        """Caller holds ``_wlock``. Size-triggered rotation happens BEFORE
+        the write so a rotated segment never exceeds the bound by more
+        than one row."""
+        if self._max_bytes and self._size + len(line) > self._max_bytes \
+                and self._size > 0:
+            self._rotate_locked()
+        self._jsonl.write(line)
+        self._size += len(line)
+
+    def _rotate_locked(self) -> None:
+        """Shift ``.1 -> .2 -> ...`` (dropping the oldest past
+        ``max_segments``), atomically rename the live file to ``.1``, and
+        reopen. Rotation failures degrade to an unbounded stream — a full
+        disk must not kill the run over telemetry."""
+        try:
+            self._jsonl.close()
+            oldest = f"{self._path}.{self._max_segments}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self._max_segments - 1, 0, -1):
+                seg = f"{self._path}.{i}"
+                if os.path.exists(seg):
+                    os.replace(seg, f"{self._path}.{i + 1}")
+            os.replace(self._path, f"{self._path}.1")
+        except OSError as e:
+            log.warning("metrics rotation failed (%s); stream unbounded "
+                        "until it succeeds", e)
+        self._jsonl = open(self._path, "a", buffering=1)
+        self._size = self._jsonl.tell()
 
     def write_images(self, step: int, tag: str, images) -> None:
         """Image summaries (parity with reference cifar_input.py:114's
@@ -270,7 +346,7 @@ class MetricsWriter:
         for k, v in scalars.items():
             rec[k] = float(v)
         with self._wlock:
-            self._jsonl.write(json.dumps(rec) + "\n")
+            self._write_line(json.dumps(rec) + "\n")
         if self._tb is not None:
             for k, v in scalars.items():
                 self._tb.add_scalar(k, float(v), int(step))
@@ -290,10 +366,14 @@ class MetricsWriter:
         rec = {"event": event, "time": time.time()}
         rec.update(payload)
         with self._wlock:
-            self._jsonl.write(json.dumps(rec) + "\n")
+            self._write_line(json.dumps(rec) + "\n")
 
     def flush(self) -> None:
-        self._jsonl.flush()
+        # under _wlock: rotation closes and swaps the handle mid-write —
+        # an unlocked flush from the watchdog/tracer thread could hit the
+        # closed file
+        with self._wlock:
+            self._jsonl.flush()
         if self._tb is not None:
             self._tb.flush()
 
@@ -384,13 +464,32 @@ class Throughput:
         return out
 
 
-def read_metrics(logdir: str, filename: str = "metrics.jsonl"):
-    """Load the JSONL event stream back (for tests/analysis)."""
+def read_metrics(logdir: str, filename: str = "metrics.jsonl",
+                 tolerant: bool = False):
+    """Load the JSONL event stream back (for tests/analysis/monitor),
+    including rotated segments in order: ``metrics.jsonl.N`` (oldest,
+    highest N) down to ``.1``, then the live file — one continuous stream
+    across rotations. ``tolerant`` skips torn lines (a live writer can be
+    mid-row) instead of raising."""
     path = os.path.join(logdir, filename)
+    segments = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        segments.append(f"{path}.{i}")
+        i += 1
+    paths = list(reversed(segments))
+    if os.path.exists(path) or not paths:
+        paths.append(path)  # preserve FileNotFoundError when nothing exists
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    if not tolerant:
+                        raise
     return out

@@ -80,6 +80,29 @@ def trace(logdir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
+def trace_window(logdir: str, duration_secs: float = 5.0) -> str:
+    """On-demand jax.profiler window: start, wait ``duration_secs``, stop.
+
+    The flight recorder's anomaly hook (telemetry/tracer.py,
+    ``telemetry.profile_on_anomaly``) calls this from the watchdog's
+    daemon thread so a hang/straggler incident captures DEVICE-side
+    activity alongside the host-side span dump — profiling runs out of
+    band of the (possibly wedged) main thread. Safe to call anywhere; a
+    profiler that is already active raises inside jax and the caller
+    treats that as best-effort."""
+    import os
+    import time as _time
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        _time.sleep(max(0.1, duration_secs))
+    finally:
+        jax.profiler.stop_trace()
+    log.info("jax.profiler window (%.1fs) captured to %s",
+             duration_secs, logdir)
+    return logdir
+
+
 def summarize_model(trainer, batch=None) -> Dict[str, Any]:
     """Params + per-step FLOPs + peak for the trainer's compiled step."""
     out: Dict[str, Any] = {

@@ -32,6 +32,56 @@ if [[ "${1:-}" == "--fast" ]]; then
 fi
 
 # ${arr[@]+...} form: bash <4.4 trips set -u on expanding an empty array
-exec env JAX_PLATFORMS=cpu python -m pytest \
+env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_resilience.py tests/test_watchdog.py -q \
   ${MARK_ARGS[@]+"${MARK_ARGS[@]}"} -p no:cacheprovider "$@"
+
+if [[ ${#MARK_ARGS[@]} -gt 0 ]]; then
+  exit 0  # --fast gate: the flight-recorder e2e below is full-mode only
+fi
+
+# Flight-recorder smoke (docs/observability.md): freeze one of two live
+# workers mid-training (the faultinject env knob) and assert the watchdog
+# escalation leaves an AUTOMATIC trace dump — a trace*.json under
+# <log_root>/telemetry plus a {"event": "trace_dump"} row in the chief's
+# metrics — and the run still exits resumable (75).
+TROOT=$(mktemp -d)
+trap 'rm -rf "$TROOT"' EXIT
+PORT=$((20000 + RANDOM % 20000))
+set +e
+timeout -k 10 240 env JAX_PLATFORMS=cpu DRT_FAULT_FREEZE_AT_BATCH="1:5" \
+  python -m distributed_resnet_tensorflow_tpu.launch \
+  --num_processes 2 --devices_per_process 1 --port "$PORT" -- \
+  --preset smoke \
+  --set model.name=logistic --set model.input_size=192 \
+  --set model.num_classes=10 --set data.image_size=8 \
+  --set train.batch_size=16 --set train.train_steps=100000 \
+  --set train.log_every_steps=1000 --set "log_root=$TROOT" \
+  --set checkpoint.save_every_steps=0 --set checkpoint.save_every_secs=0 \
+  --set resilience.watchdog.enabled=on \
+  --set resilience.watchdog.interval_secs=0.2 \
+  --set resilience.watchdog.peer_timeout_secs=5 \
+  --set resilience.watchdog.min_step_timeout_secs=3 \
+  --set resilience.watchdog.grace_secs=1
+rc=$?
+set -e
+if [[ $rc -ne 75 ]]; then
+  echo "chaos_smoke: frozen-peer run exited $rc, expected resumable 75" >&2
+  exit 1
+fi
+if ! ls "$TROOT"/telemetry/trace*.json >/dev/null 2>&1; then
+  echo "chaos_smoke: no flight-recorder trace*.json under $TROOT/telemetry" >&2
+  exit 1
+fi
+python - "$TROOT/telemetry" <<'PY'
+import glob, json, sys
+paths = glob.glob(sys.argv[1] + "/trace*.json")
+doc = json.load(open(paths[0]))
+assert doc["traceEvents"], "trace dump holds no events"
+assert doc["otherData"]["span_schema_version"] >= 1
+PY
+if ! grep -q '"event": "trace_dump"' "$TROOT"/train/metrics.jsonl; then
+  echo "chaos_smoke: no trace_dump event row in the chief's metrics" >&2
+  exit 1
+fi
+echo "chaos_smoke: frozen-peer flight-recorder dump verified"

@@ -58,6 +58,11 @@ def build(mesh):
 
 
 memo = functools.lru_cache(maxsize=None)(build)     # line 34: cached-mesh
+
+
+def record(span):
+    with span("made_up_span"):                      # line 38: registry-drift (span catalog)
+        pass
 '''
 
 BAD_SH = '''\
@@ -70,6 +75,7 @@ python -m distributed_resnet_tensorflow_tpu.main --set trian.batch_size=64
 BAD_MD = '''\
 # stale doc
 Watch for `{"event": "vanished_event"}` rows.
+Spans land via `span("vanished.span")` in the tracer.
 '''
 
 
@@ -107,9 +113,11 @@ def test_each_rule_fires_with_file_and_line(bad_repo):
     assert (f.path, f.line) == (bad_py, 23)
     drift = {(f.path, f.line) for f in by_rule["registry-drift"]}
     assert (bad_py, 27) in drift                       # undeclared event
+    assert (bad_py, 38) in drift                       # undeclared span
     assert (os.path.join("scripts", "bad.sh"), 2) in drift  # bad --set knob
     assert (os.path.join("scripts", "bad.sh"), 4) in drift  # bad wildcard
     assert (os.path.join("docs", "bad.md"), 2) in drift     # stale doc event
+    assert (os.path.join("docs", "bad.md"), 3) in drift     # stale doc span
 
 
 def test_suppression_comment_silences_rule(bad_repo):

@@ -1,0 +1,447 @@
+"""telemetry/ suite: flight-recorder trace well-formedness (Chrome-trace
+JSON, per-thread nesting, ring bound), fake-clock goodput classification,
+metrics.jsonl rotation + read-back, decode-process counter ship-back, the
+cluster monitor aggregate, and the watchdog's anomaly-triggered dump."""
+import glob
+import json
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_resnet_tensorflow_tpu.telemetry.goodput import (
+    CATEGORIES, GoodputMeter, goodput)
+from distributed_resnet_tensorflow_tpu.telemetry.tracer import (
+    SPAN_CATALOG, SPAN_SCHEMA_VERSION, FlightRecorder, recorder)
+from distributed_resnet_tensorflow_tpu.utils.metrics import (
+    EVENT_SCHEMAS, MetricsWriter, StageStats, read_metrics)
+
+
+class FakeWriter:
+    def __init__(self):
+        self.events = []
+
+    def write_event(self, event, payload):
+        self.events.append({"event": event, **payload})
+
+    def flush(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_trace_dump_is_wellformed_chrome_trace(tmp_path):
+    rec = FlightRecorder(ring=1024)
+    with rec.span("train.step"):
+        with rec.span("input.wait"):
+            time.sleep(0.002)
+        time.sleep(0.002)
+
+    def worker():
+        with rec.span("input.stage"):
+            time.sleep(0.002)
+
+    t = threading.Thread(target=worker, name="stage-thread")
+    t.start()
+    t.join()
+
+    path = rec.dump(str(tmp_path / "trace.json"), reason="test")
+    doc = json.load(open(path))  # loads = Perfetto/chrome://tracing accepts
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["otherData"]["span_schema_version"] == SPAN_SCHEMA_VERSION
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"train.step", "input.wait",
+                                       "input.stage"}
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] > 0 and "tid" in e and "pid" in e
+    # thread-name metadata lanes for every emitting thread
+    meta = [e for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert {e["tid"] for e in meta} >= {e["tid"] for e in xs}
+    # spans NEST per thread: input.wait lies within train.step's window
+    # on the same tid; the other thread's span has a different tid
+    by_name = {e["name"]: e for e in xs}
+    outer, inner = by_name["train.step"], by_name["input.wait"]
+    assert inner["tid"] == outer["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+    assert by_name["input.stage"]["tid"] != outer["tid"]
+
+
+def test_ring_bound_is_honored():
+    rec = FlightRecorder(ring=64)
+    for _ in range(500):
+        with rec.span("train.step"):
+            pass
+    assert len(rec) == 64
+    assert sum(1 for e in rec.trace_events() if e["ph"] == "X") == 64
+
+
+def test_disabled_recorder_records_nothing():
+    rec = FlightRecorder(ring=64, enabled=False)
+    with rec.span("train.step"):
+        pass
+    assert len(rec) == 0
+
+
+def test_unknown_span_warns_but_records(caplog):
+    rec = FlightRecorder(ring=16)
+    with rec.span("totally.unregistered.span"):  # shardcheck: ok(registry-drift)
+        pass
+    assert len(rec) == 1
+
+
+def test_dump_without_configuration_is_a_noop():
+    rec = FlightRecorder(ring=16)
+    assert rec.dump(reason="x") is None  # no dump dir known — never raises
+
+
+def test_span_catalog_covers_every_emitted_literal():
+    """Every span name the package emits resolves in SPAN_CATALOG (the
+    registry-drift rule enforces it repo-wide; this pins the catalog
+    against accidental deletion) and trace_dump/goodput are registered
+    events."""
+    assert "goodput" in EVENT_SCHEMAS and "trace_dump" in EVENT_SCHEMAS
+    for name in ("input.wait", "train.step", "eval.round",
+                 "checkpoint.save", "serve.batch", "restore"):
+        assert name in SPAN_CATALOG
+
+
+# ---------------------------------------------------------------------------
+# goodput classification (fake clock)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_goodput_interval_classifies_and_sums_to_100():
+    clock = FakeClock()
+    m = GoodputMeter(clock=clock)
+    m.rebase()
+    clock.t += 10.0
+    m.add("input_wait", 2.0)
+    m.add("checkpoint", 1.0)
+    m.add("eval", 0.5)
+    itv = m.interval()
+    assert itv["wall_secs"] == 10.0
+    assert itv["seconds"]["compute"] == pytest.approx(6.5)
+    assert itv["seconds"]["input_wait"] == pytest.approx(2.0)
+    assert set(itv["pct"]) == set(CATEGORIES)
+    assert sum(itv["pct"].values()) == pytest.approx(100.0, abs=0.1)
+    # the next interval starts fresh
+    clock.t += 4.0
+    m.add("stall", 4.0)
+    itv2 = m.interval()
+    assert itv2["seconds"]["compute"] == pytest.approx(0.0)
+    assert itv2["seconds"]["stall"] == pytest.approx(4.0)
+    assert itv2["seconds"]["input_wait"] == pytest.approx(0.0)
+
+
+def test_goodput_overmeasured_interval_normalizes():
+    """Charges exceeding the wall (a second thread charging the same
+    window) clamp compute at 0 and normalize pct over the measured sum —
+    never >100% total."""
+    clock = FakeClock()
+    m = GoodputMeter(clock=clock)
+    m.rebase()
+    clock.t += 5.0
+    m.add("checkpoint", 8.0)
+    itv = m.interval()
+    assert itv["seconds"]["compute"] == 0.0
+    assert sum(itv["pct"].values()) == pytest.approx(100.0, abs=0.1)
+
+
+def test_goodput_first_interval_without_rebase_is_empty():
+    m = GoodputMeter(clock=FakeClock())
+    itv = m.interval()
+    assert itv["wall_secs"] == 0.0
+
+
+def test_nested_categorized_spans_charge_outermost_only():
+    before = goodput.snapshot()
+    with recorder.span("eval.round", category="eval"):
+        with recorder.span("input.wait", category="input_wait"):
+            time.sleep(0.002)
+        time.sleep(0.002)
+    after = goodput.snapshot()
+    assert after.get("eval", 0) > before.get("eval", 0)
+    # the inner categorized span charged NOTHING (outermost-span rule)
+    assert after.get("input_wait", 0) == pytest.approx(
+        before.get("input_wait", 0))
+
+
+def test_goodput_hook_emits_registered_event(tmp_path):
+    from distributed_resnet_tensorflow_tpu.train.hooks import GoodputHook
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=False)
+    hook = GoodputHook(w, every_steps=10)
+    hook.reset_window()
+    goodput.add("input_wait", 0.001)
+    time.sleep(0.005)
+    hook(10, None, {})
+    w.close()
+    rows = [r for r in read_metrics(str(tmp_path))
+            if r.get("event") == "goodput"]
+    assert rows, "no goodput row emitted"
+    row = rows[-1]
+    assert row["step"] == 10
+    assert set(row["pct"]) == set(CATEGORIES)
+    assert sum(row["pct"].values()) == pytest.approx(100.0, abs=0.5)
+
+
+# ---------------------------------------------------------------------------
+# metrics.jsonl rotation
+# ---------------------------------------------------------------------------
+
+def test_metrics_rotation_bounds_size_and_reads_in_order(tmp_path):
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=False,
+                      max_bytes=600, max_segments=3)
+    for i in range(60):
+        w.write_scalars(i, {"loss": float(i)})
+    w.close()
+    base = os.path.join(str(tmp_path), "metrics.jsonl")
+    segs = sorted(glob.glob(base + ".*"))
+    assert segs, "no rotation happened"
+    assert len(segs) <= 3
+    # every file honors the bound (±1 row slack by construction)
+    for p in segs + [base]:
+        assert os.path.getsize(p) <= 600 + 120
+    rows = read_metrics(str(tmp_path))
+    steps = [r["step"] for r in rows]
+    # one continuous, ordered stream ending at the newest row; the oldest
+    # rows beyond the segment budget are gone
+    assert steps == sorted(steps)
+    assert steps[-1] == 59
+    assert len(set(steps)) == len(steps)
+
+
+def test_read_metrics_tolerant_skips_torn_tail(tmp_path):
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=False)
+    w.write_scalars(1, {"loss": 1.0})
+    w.close()
+    with open(os.path.join(str(tmp_path), "metrics.jsonl"), "a") as f:
+        f.write('{"step": 2, "loss"')  # torn mid-write
+    with pytest.raises(ValueError):
+        read_metrics(str(tmp_path))
+    rows = read_metrics(str(tmp_path), tolerant=True)
+    assert [r["step"] for r in rows] == [1]
+
+
+def test_rotation_off_by_default_threshold_not_hit(tmp_path):
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=False)
+    for i in range(20):
+        w.write_scalars(i, {"loss": 0.0})
+    w.close()
+    assert not glob.glob(os.path.join(str(tmp_path), "metrics.jsonl.*"))
+    assert len(read_metrics(str(tmp_path))) == 20
+
+
+# ---------------------------------------------------------------------------
+# decode-process stage-counter ship-back (satellite)
+# ---------------------------------------------------------------------------
+
+def test_stage_stats_worker_merge_keeps_busiest_worker_honest():
+    s = StageStats()
+    s.add("decode", 2.0, items=10, worker=("decode-proc", 0))
+    s.add("decode", 3.0, items=20, worker=("decode-proc", 1))
+    s.add("decode", 1.0, items=5, worker=("decode-proc", 0))
+    snap = s.snapshot()["decode"]
+    assert snap["workers"] == 2
+    assert snap["items"] == 35
+    assert snap["seconds"] == pytest.approx(6.0)
+    # busiest worker = proc0's 3.0 cumulative, not the 6.0 sum
+    assert snap["max_thread_seconds"] == pytest.approx(3.0)
+
+
+def _jpeg_bytes(size=48):
+    import io
+
+    from PIL import Image
+    img = np.random.RandomState(0).randint(0, 256, (size, size, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "JPEG")
+    return buf.getvalue()
+
+
+def test_decode_loop_process_mode_ships_counter_deltas():
+    """Process-mode _decode_loop (stop=None) must put _StageDelta rows on
+    the result queue BEFORE its _END marker — the parent stops consuming
+    at the n-th _END, so a later delta would be lost."""
+    from distributed_resnet_tensorflow_tpu.data.imagenet import (
+        _decode_loop, _END, _EndMarker, _StageDelta)
+    jpeg = _jpeg_bytes()
+    in_q, out_q = queue.Queue(), queue.Queue()
+    for _ in range(3):
+        in_q.put((jpeg, 1))
+    in_q.put(_END)
+    _decode_loop(in_q, out_q, wseed=0, is_train=False, image_size=32,
+                 native_decode=False, emit_uint8=True, stop=None, widx=7)
+    items = []
+    while not out_q.empty():
+        items.append(out_q.get_nowait())
+    deltas = [i for i in items if isinstance(i, _StageDelta)]
+    ends = [i for i, it in enumerate(items) if isinstance(it, _EndMarker)]
+    assert deltas and sum(d.count for d in deltas) == 3
+    assert all(d.widx == 7 for d in deltas)
+    assert all(d.seconds > 0 for d in deltas)
+    delta_idx = [i for i, it in enumerate(items)
+                 if isinstance(it, _StageDelta)]
+    assert max(delta_idx) < min(ends), "delta after _END would be dropped"
+
+
+def test_decode_process_counters_merge_into_parent_registry(tmp_path):
+    """E2E: decode_processes > 0 leaves decode busy-time in the PARENT's
+    input_stages — the attribution gap this satellite closes."""
+    from test_imagenet_data import _write_fake_imagenet
+
+    from distributed_resnet_tensorflow_tpu.data.imagenet import (
+        imagenet_iterator)
+    from distributed_resnet_tensorflow_tpu.utils.metrics import input_stages
+    d, total = _write_fake_imagenet(tmp_path, mode="validation")
+    input_stages.reset()
+    it = imagenet_iterator(d, batch_size=5, mode="eval", image_size=32,
+                           decode_processes=1)
+    n = 0
+    for b in it:
+        mask = b.get("mask", np.ones(len(b["labels"])))
+        n += int(mask.sum())
+    assert n == total
+    snap = input_stages.snapshot()
+    assert "decode" in snap, "no decode counters merged from the worker"
+    assert snap["decode"]["items"] == total
+    assert snap["decode"]["seconds"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cluster monitor
+# ---------------------------------------------------------------------------
+
+def _write_stream(d, rows):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "metrics.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_monitor_aggregates_two_host_streams(tmp_path):
+    from distributed_resnet_tensorflow_tpu.telemetry.monitor import aggregate
+    now = 1000.0
+    _write_stream(str(tmp_path / "host0" / "train"), [
+        {"step": 100, "time": now - 20, "loss": 2.0},
+        {"step": 200, "time": now - 10, "loss": 1.5},
+        {"event": "goodput", "time": now - 10, "step": 200,
+         "wall_secs": 10.0,
+         "seconds": {c: 0.0 for c in CATEGORIES},
+         "pct": {"compute": 80.0, "input_wait": 20.0, "checkpoint": 0.0,
+                 "eval": 0.0, "stall": 0.0, "restart": 0.0}},
+    ])
+    _write_stream(str(tmp_path / "host1" / "train"), [
+        {"step": 100, "time": now - 20, "loss": 2.1},
+        {"step": 150, "time": now - 10, "loss": 1.9},
+    ])
+    hb = tmp_path / "heartbeats"
+    hb.mkdir()
+    for pid, step in ((0, 200), (1, 150)):
+        (hb / f"proc{pid}.json").write_text(json.dumps({
+            "process_id": pid, "pid": 10 + pid, "host": f"h{pid}",
+            "seq": 9, "step": step, "progress": step, "phase": "train",
+            "wall_time": now - 1}))
+    agg = aggregate(str(tmp_path), now=now)
+    assert set(agg["streams"]) == {os.path.join("host0", "train"),
+                                   os.path.join("host1", "train")}
+    s0 = agg["streams"][os.path.join("host0", "train")]
+    assert s0["step"] == 200
+    assert s0["steps_per_sec"] == pytest.approx(10.0)
+    assert s0["goodput_pct"] == pytest.approx(80.0)
+    s1 = agg["streams"][os.path.join("host1", "train")]
+    assert s1["steps_per_sec"] == pytest.approx(5.0)
+    # cluster headline: the fastest (chief) stream leads
+    assert agg["steps_per_sec"] == pytest.approx(10.0)
+    assert agg["goodput"]["compute"] == pytest.approx(80.0)
+    assert set(agg["hosts"]) == {"0", "1"}
+    assert agg["host_step_skew"] == 50
+    assert "stale_hosts" not in agg
+
+
+def test_monitor_once_json_cli(tmp_path, capsys):
+    from distributed_resnet_tensorflow_tpu.telemetry.monitor import (
+        main_monitor, render)
+    _write_stream(str(tmp_path / "train"), [
+        {"step": 10, "time": time.time() - 5, "loss": 1.0},
+        {"step": 20, "time": time.time(), "loss": 0.9},
+    ])
+    rc = main_monitor(["--root", str(tmp_path), "--once", "--json"])
+    assert rc == 0
+    agg = json.loads(capsys.readouterr().out)
+    assert "train" in agg["streams"]
+    assert agg["streams"]["train"]["step"] == 20
+    # the text renderer stays crash-free on the same aggregate
+    assert "drt monitor" in render(agg)
+
+
+def test_monitor_tolerates_torn_and_empty_streams(tmp_path):
+    from distributed_resnet_tensorflow_tpu.telemetry.monitor import aggregate
+    d = tmp_path / "train"
+    d.mkdir(parents=True)
+    (d / "metrics.jsonl").write_text('{"step": 1, "time": 1.0}\n{"torn')
+    agg = aggregate(str(tmp_path))
+    assert agg["streams"]["train"]["step"] == 1
+
+
+# ---------------------------------------------------------------------------
+# watchdog anomaly hook
+# ---------------------------------------------------------------------------
+
+def test_watchdog_escalation_dumps_flight_record(tmp_path):
+    """A hang escalation must leave trace.json + a trace_dump metrics row
+    + a goodput stall charge — the automatic flight-recorder contract
+    (the live 2-process frozen-peer path is scripts/chaos_smoke.sh)."""
+    from distributed_resnet_tensorflow_tpu.resilience.heartbeat import (
+        HeartbeatPublisher, BeatTransport)
+    from distributed_resnet_tensorflow_tpu.resilience.watchdog import Watchdog
+    from distributed_resnet_tensorflow_tpu.utils.config import WatchdogConfig
+
+    class NullTransport(BeatTransport):
+        def publish(self, beat):
+            pass
+
+        def peers(self):
+            return {}
+
+    dump_dir = str(tmp_path / "telemetry")
+    stub = FakeWriter()
+    recorder.configure(dump_dir=dump_dir, writer=stub, process_index=0)
+    try:
+        with recorder.span("train.step"):
+            pass
+        clock = FakeClock()
+        publisher = HeartbeatPublisher(NullTransport(), 0, clock=clock)
+        publisher.update(step=3, phase="train")
+        stall_before = goodput.snapshot().get("stall", 0.0)
+        clock.t += 42.0
+        wd = Watchdog(NullTransport(), publisher, 0, 2,
+                      WatchdogConfig(), writer=FakeWriter(),
+                      clock=clock, exit_fn=lambda code: None)
+        wd._escalate("hang", 75, "no progress for 42s", now=clock.t)
+        path = os.path.join(dump_dir, "trace.json")
+        assert os.path.exists(path)
+        doc = json.load(open(path))
+        assert doc["otherData"]["reason"] == "hang"
+        assert any(e.get("name") == "train.step"
+                   for e in doc["traceEvents"])
+        dumps = [e for e in stub.events if e["event"] == "trace_dump"]
+        assert dumps and dumps[0]["reason"] == "hang"
+        assert dumps[0]["span_schema_version"] == SPAN_SCHEMA_VERSION
+        assert goodput.snapshot()["stall"] - stall_before == \
+            pytest.approx(42.0)
+    finally:
+        recorder._writer = None  # don't leak the stub into other tests
