@@ -246,6 +246,11 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     except Exception:
         pass
     out["host_cores"] = ncpu
+    # the decode-pool width the auto defaults resolve to on this host
+    # (data.resolve_decode_workers; explicit --set values would win)
+    from distributed_resnet_tensorflow_tpu.data import resolve_decode_workers
+    _p, _t = resolve_decode_workers(get_preset("imagenet_resnet50"))
+    out["decode_workers_resolved"] = {"processes": _p, "threads": _t}
 
     # shared transfer probe: one imagenet-sized uint8 batch (128×224²×3 =
     # 19.3 MB) through device_put, so BOTH e2e rows below carry their own
@@ -266,29 +271,45 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     out["transfer_probe"] = {"device_put_MBps": round(put_mbps, 1),
                              "images_per_sec": round(ship_rate, 1)}
 
-    def attribute(e2e_rate, snap, extra):
+    def attribute(e2e_rate, snap, extra, host_echo=1, transfer_echo=1):
         """Attribution FROM THE STAGE COUNTERS of the run itself
-        (utils.metrics.input_stages; stages decode / stack / stage /
+        (utils.metrics.input_stages; stages decode / echo / stack / stage /
         transfer instrumented in the pipeline threads), not from components
         re-measured in isolation: each stage's rate is items over its
         busiest worker's busy time DURING the e2e run, so when the stages
         genuinely overlap, e2e_vs_slowest_component sits near 1.0 — and
         when staging is serial it honestly sits low. ``extra`` carries the
-        device-side probe (the one leg the input counters can't see)."""
-        rates = dict(extra)
+        device-side probe (the one leg the input counters can't see).
+
+        Echo awareness: with data echoing on, one decoded image feeds
+        host_echo × transfer_echo steps and one shipped image feeds
+        transfer_echo steps, so each stage's EFFECTIVE ceiling on the e2e
+        rate is its raw busy rate times the echo factors downstream of it
+        — those effective rates are what the bottleneck comparison uses
+        (raw rates ride in stage_rates_raw_images_per_sec)."""
+        raw = dict(extra)
         nbytes_per_s = {}
-        for stage in ("decode", "stack", "stage", "transfer"):
+        for stage in ("decode", "echo", "stack", "stage", "transfer"):
             agg = snap.get(stage)
             if agg and agg["items"] and agg["max_thread_seconds"] > 0:
-                rates[stage] = agg["items"] / agg["max_thread_seconds"]
+                raw[stage] = agg["items"] / agg["max_thread_seconds"]
                 if agg.get("bytes"):
                     nbytes_per_s[stage] = agg["bytes"] / agg["seconds"]
+        mult = {"decode": host_echo * transfer_echo, "echo": transfer_echo,
+                "stack": transfer_echo, "stage": transfer_echo,
+                "transfer": transfer_echo}
+        rates = {k: v * mult.get(k, 1) for k, v in raw.items()}
         out = {"uint8_MB_per_image": round(bytes_per_image / 1e6, 3),
                "device_put_probe_MBps": round(put_mbps, 1),
                "stage_rates_images_per_sec": {
                    k: round(v, 1) for k, v in rates.items()},
                "dispatch_wait_seconds": round(
                    snap.get("dispatch_wait", {}).get("seconds", 0.0), 3)}
+        if host_echo > 1 or transfer_echo > 1:
+            out["stage_rates_raw_images_per_sec"] = {
+                k: round(v, 1) for k, v in raw.items()}
+            out["echo_factors"] = {"host": host_echo,
+                                   "transfer": transfer_echo}
         if "transfer" in nbytes_per_s:
             # the coalesced path's measured H2D bandwidth (bytes the
             # staging thread moved over its transfer busy time)
@@ -319,7 +340,7 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     try:
         cfg = get_preset("imagenet_resnet50")
         cfg.data.data_dir = d
-        cfg.data.num_parallel_calls = max(4, ncpu)
+        # decode pool width rides the auto defaults (resolved above)
         cfg.data.use_native_loader = True
         cfg.mesh.data = len(jax.devices())
         ev_host = create_input_iterator(cfg, mode="eval")
@@ -373,28 +394,45 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     if budget_left() < 60:
         out["skipped_e2e"] = "over bench budget"
         return out
-    # (b) end-to-end streamed training (decode host-bound on small hosts;
-    # the gap to the synthetic rate IS the finding)
+    # (b) end-to-end streamed training with the round-9 input stack ON:
+    # auto-scaled decode workers, data echoing over the decoded-sample
+    # cache (echo_factor), transfer-level echo (echo_transfer: one H2D
+    # transfer feeds echo_transfer × steps_per_loop steps, reshuffled +
+    # re-augmented on device), double-buffered staging. The gap to the
+    # synthetic rate IS the finding.
+    from distributed_resnet_tensorflow_tpu.utils.metrics import echo_stats
     cfg = get_preset("imagenet_resnet50")
     cfg.train.batch_size = 128
     cfg.train.steps_per_loop = 4
     cfg.data.data_dir = d
-    cfg.data.num_parallel_calls = max(4, ncpu)
+    cfg.data.echo_factor = 2
+    cfg.data.echo_transfer = 2
     cfg.mesh.data = len(jax.devices())
     trainer = Trainer(cfg)
     trainer.init_state()
     stream = create_input_iterator(cfg, mode="train")
-    trainer.train(stream, num_steps=4)  # warmup/compile
+    # warmup covers compile AND pipeline ramp (queues, echo cache, decode
+    # pool) so the timed window is steady state
+    trainer.train(stream, num_steps=16)
     jax.block_until_ready(trainer.state.params)
-    input_stages.reset()  # attribution counters cover the timed run only
-    n_s = 12
+    # attribution counters and echo telemetry cover the timed run only
+    input_stages.reset()
+    echo_stats.reset()
+    n_s = 24
     t0 = time.perf_counter()
-    trainer.train(stream, num_steps=n_s)
+    trainer.train(stream, num_steps=16 + n_s, start_step=16)
     jax.block_until_ready(trainer.state.params)
     sps = n_s / (time.perf_counter() - t0)
     train_snap = input_stages.snapshot()
+    echo_snap = echo_stats.snapshot()
     out["real_input_images_per_sec"] = round(sps * 128, 1)
     out["real_input_steps_per_sec"] = round(sps, 3)
+    out["echo_factor"] = cfg.data.echo_factor
+    out["echo_transfer"] = cfg.data.echo_transfer
+    out["echo_cache_hit_rate"] = echo_snap["hit_rate"]
+    out["echo"] = {k: echo_snap[k] for k in
+                   ("decoded", "emitted", "hits", "evictions",
+                    "peak_cache_bytes")}
     # decomposition from the run's own stage counters (decode / stack /
     # stage / transfer busy rates) plus the device train rate — the one
     # leg the input counters can't see. The device leg reuses the
@@ -404,8 +442,12 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
     try:
         from distributed_resnet_tensorflow_tpu.parallel.sharding import (
             shard_stacked_batch)
+        # probe batch dtype must match the streamed path's compiled trace:
+        # with the fused-unpack augmentation the step consumes augmented
+        # float32; otherwise raw uint8 (the step augments)
+        img_dt = np.float32 if trainer.train_put_augments else np.uint8
         stacked = shard_stacked_batch({
-            "images": np.zeros((4, 128, 224, 224, 3), np.uint8),
+            "images": np.zeros((4, 128, 224, 224, 3), img_dt),
             "labels": np.zeros((4, 128), np.int32)}, trainer.mesh)
         multi = trainer.jitted_multi_step(4)
         st = trainer.state
@@ -420,7 +462,9 @@ def bench_imagenet_input(budget_left):  # budget_left: () -> seconds left
         out["device_train_images_per_sec"] = round(extra["device_train"], 1)
     except Exception as e:
         out["device_train_probe_error"] = f"{type(e).__name__}: {e}"[:160]
-    out["real_input_attribution"] = attribute(sps * 128, train_snap, extra)
+    out["real_input_attribution"] = attribute(
+        sps * 128, train_snap, extra, host_echo=cfg.data.echo_factor,
+        transfer_echo=cfg.data.echo_transfer)
     return out
 
 

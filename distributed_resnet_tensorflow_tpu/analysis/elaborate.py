@@ -15,7 +15,10 @@ pushes shape/dtype-only values through:
     tests/test_pipeline.py was located exactly this way),
   * the eval step,
   * the serve/predict step, once per batch bucket the inference server
-    would AOT-compile (serve/compile_cache.bucket_sizes), and
+    would AOT-compile (serve/compile_cache.bucket_sizes),
+  * the coalesced staged-unpack program — with the fused on-device
+    imagenet augmentation when the preset would run it
+    (parallel/sharding.abstract_staged_unpack), flat and stacked, and
   * the checkpoint-restore contract (layout stamp + unique leaf paths).
 
 Zero data, zero compute, no compilation: the whole ``--all-presets``
@@ -256,6 +259,52 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
                 findings.append(_findings_from_exc(
                     "elab-serve-step", locus,
                     f"serve step (bucket {bucket})", e))
+
+        # coalesced staged-unpack program (parallel/sharding._build_unpack)
+        # — and, for imagenet presets, the FUSED on-device augmentation
+        # riding inside it — traced abstractly per preset, flat and
+        # stacked, same gate contract as the serve buckets: an unpack or
+        # augment program that cannot trace is a finding here, not a
+        # step-1 crash after cluster spin-up. Layouts whose local batch
+        # does not divide the batch shards are skipped (every put path
+        # rejects those loudly at runtime already — not this gate's bug
+        # class).
+        try:
+            from ..parallel.sharding import (_device_batch_shards,
+                                             abstract_staged_unpack)
+            bs = cfg.train.batch_size
+            n_local = len({s for _, s in _device_batch_shards(mesh)})
+            if bs % n_local == 0:
+                imagenet = cfg.data.dataset == "imagenet"
+                img_dt = np.uint8 if imagenet else np.float32
+                # trace the augmenting unpack only when the Trainer
+                # would actually build one (imagenet + device_augment
+                # not forced off + no transfer reuse — loop.py mirrors
+                # this); the neutral unpack is traced for every preset
+                fuses = imagenet and cfg.data.device_augment != "off" \
+                    and cfg.data.echo_transfer <= 1
+                augments = [None] + (
+                    [("images", "imagenet_train", cfg.data.augment_pad)]
+                    if fuses else [])
+                s = cfg.data.image_size
+                k = max(2, cfg.train.steps_per_loop)
+                for stacked in (False, True):
+                    if cfg.model.name == "logistic":
+                        ishape = (cfg.model.input_size,)
+                    else:
+                        ishape = (s, s, 3)
+                    lead = (k, bs) if stacked else (bs,)
+                    batch_shapes = {
+                        "images": jax.ShapeDtypeStruct(lead + ishape,
+                                                       img_dt),
+                        "labels": jax.ShapeDtypeStruct(lead, np.int32)}
+                    for augment in augments:
+                        abstract_staged_unpack(
+                            mesh, batch_shapes, stacked=stacked,
+                            augment=augment, augment_seed=cfg.train.seed)
+        except Exception as e:
+            findings.append(_findings_from_exc(
+                "elab-unpack", locus, "staged unpack (+fused augment)", e))
 
     # restore contract: the layout stamp must compute, and every leaf path
     # must be unique (the checkpoint manifest is keyed by flattened path)

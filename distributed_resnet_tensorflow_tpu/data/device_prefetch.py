@@ -86,12 +86,18 @@ def device_prefetch(host_iter: Iterator, put: Callable, depth: int = 2
         # Batches are yielded the moment their transfer is ISSUED (jax
         # arrays are futures — the consumer's dispatch does not need them
         # materialized), so a put() blocked on batch N never withholds an
-        # already-issued batch from the consumer. Before issuing N+1 the
-        # thread waits for N's transfer to complete: that keeps exactly one
-        # transfer in flight behind the current put AND makes the
-        # "transfer" counter reflect true H2D throughput (issue alone is
-        # async and near-free).
-        prev = None  # (device_batch, items, issue_seconds)
+        # already-issued batch from the consumer. The issue point is
+        # DOUBLE-BUFFERED (round 9): up to two issued transfers ride
+        # behind the current put before the thread waits on the oldest,
+        # so packing batch N+1 (host memcpy, the "stage" counter) overlaps
+        # batch N's H2D DMA instead of serializing with it — the residue
+        # behind BENCH_r05's e2e_vs_slowest_component = 0.544. The wait on
+        # the oldest still makes the "transfer" counter reflect true H2D
+        # throughput (issue alone is async and near-free), and the
+        # staging ring bounds how far the host buffers can run ahead
+        # (a slot is only rewritten once its transfer completed).
+        from collections import deque
+        pending = deque()  # (device_batch, items, issue_seconds)
 
         def charge(entry):
             dev, items, issue_s = entry
@@ -120,12 +126,12 @@ def device_prefetch(host_iter: Iterator, put: Callable, depth: int = 2
                 with span("input.stage"):
                     out = put(batch)
                 issue_s = time.perf_counter() - t0
-                if prev is not None:
-                    charge(prev)
-                prev = (out, items, issue_s)
+                pending.append((out, items, issue_s))
+                while len(pending) > 2:  # double-buffered issue window
+                    charge(pending.popleft())
                 yield out
-            if prev is not None:
-                charge(prev)
+            while pending:
+                charge(pending.popleft())
         finally:
             # propagate close() (e.g. Trainer replacing its cached
             # prefetcher) down to the source so worker threads shut down

@@ -70,15 +70,24 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                       shuffle_buffer: int = SHUFFLE_BUFFER,
                       use_native: bool = False,
                       device_standardize: bool = False,
+                      device_flip: bool = False,
                       decode_processes: int = 0,
                       deterministic: bool = False,
                       max_corrupt_records: int = 0,
                       verify_crc: bool = False,
                       ) -> Iterator[Dict[str, np.ndarray]]:
-    """``device_standardize``: batches stay uint8 (crop/flip done, VGG
-    mean-subtract deferred to ops/augment.vgg_standardize inside the jitted
-    step) — 4× smaller host→device transfers and no host float pass. Both
-    modes use the fused DCT-scaled decode (preprocessing.decode_and_resize).
+    """``device_standardize``: batches stay uint8 (crop done, VGG
+    mean-subtract deferred to ops/augment inside the jitted step or the
+    staged-unpack program) — 4× smaller host→device transfers and no host
+    float pass. Both modes use the fused DCT-scaled decode
+    (preprocessing.decode_and_resize).
+
+    ``device_flip``: the device augmentation owns the horizontal flip
+    (ops/augment.imagenet_train_augment draws one per appearance — fresh
+    per echo, data/echo.py), so the host decode draws its flip (the RNG
+    stream contract keeps the draw order: side, top, left, flip) but does
+    NOT apply it. Train mode only; without it device-augmented batches
+    would be flipped twice.
 
     ``decode_processes`` > 0 replaces the decode THREAD pool with worker
     PROCESSES (fork): full GIL independence for the decode stage, at the
@@ -141,8 +150,14 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
 
     def record_stream(ordered_files):
         if native:
-            pf = NativePrefetcher(list(ordered_files),
-                                  num_threads=min(4, len(ordered_files)))
+            # record-reader threads track the decode width (round 9): a
+            # 4-thread reader fed an 8-wide decode pool starved it on
+            # fast storage
+            pf = NativePrefetcher(
+                list(ordered_files),
+                num_threads=min(len(ordered_files),
+                                max(4, decode_processes,
+                                    num_decode_threads)))
             try:
                 yield from pf
             finally:
@@ -237,7 +252,7 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                               seed * 7919 if deterministic
                               else seed * 7919 + i,
                               is_train, image_size, native_decode,
-                              emit_uint8, deterministic, i),
+                              emit_uint8, deterministic, i, device_flip),
                         daemon=True)
             for i in range(n_workers)]
         for w in workers:
@@ -286,7 +301,7 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
             wseed = seed * 7919 if deterministic else seed * 7919 + widx
             _decode_loop(in_q, out_q, wseed, is_train,
                          image_size, native_decode, emit_uint8, stop,
-                         deterministic, widx)
+                         deterministic, widx, device_flip)
         except BaseException as e:
             out_q.put(_Failure(repr(e)))
 
@@ -426,7 +441,8 @@ _END = _EndMarker()
 
 
 def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
-                 emit_uint8, stop=None, deterministic=False, widx=0):
+                 emit_uint8, stop=None, deterministic=False, widx=0,
+                 device_flip=False):
     from .preprocessing import (RGB_MEANS, eval_crop_from_bytes,
                                 train_crop_from_bytes)
     import queue as queue_mod
@@ -504,7 +520,8 @@ def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
             with span("input.decode"):
                 if is_train:
                     img = train_crop_from_bytes(data, rng, image_size,
-                                                use_native=native_decode)
+                                                use_native=native_decode,
+                                                apply_flip=not device_flip)
                 else:
                     img = eval_crop_from_bytes(data, image_size,
                                                use_native=native_decode)
@@ -529,11 +546,12 @@ def _decode_loop(in_q, out_q, wseed, is_train, image_size, native_decode,
 
 
 def _decode_worker(in_q, out_q, wseed, is_train, image_size, native_decode,
-                   emit_uint8, deterministic=False, widx=0):
+                   emit_uint8, deterministic=False, widx=0,
+                   device_flip=False):
     """Process-pool worker body (fork target)."""
     try:
         _decode_loop(in_q, out_q, wseed, is_train, image_size,
                      native_decode, emit_uint8, deterministic=deterministic,
-                     widx=widx)
+                     widx=widx, device_flip=device_flip)
     except BaseException as e:  # pragma: no cover - transported to parent
         out_q.put(_Failure(repr(e)))

@@ -63,16 +63,20 @@ def decode_and_resize(data: bytes, smaller_side: int) -> np.ndarray:
 
 
 def _random_crop_flip(image: np.ndarray, rng: np.random.RandomState,
-                      output_size: int) -> np.ndarray:
+                      output_size: int,
+                      apply_flip: bool = True) -> np.ndarray:
     """Random output_size² crop + horizontal flip (reference
     _random_crop:88 + flip). One definition shared by the decoded-array and
     the fused-decode paths — the RNG draw order (top, left, flip) is part
-    of the contract."""
+    of the contract: with ``apply_flip=False`` (device-side augmentation
+    owns the flip — ops/augment.imagenet_train_augment) the flip is still
+    DRAWN, just not applied, so a fixed seed selects identical crop
+    geometry whichever side flips."""
     h, w = image.shape[:2]
     top = rng.randint(0, h - output_size + 1)
     left = rng.randint(0, w - output_size + 1)
     crop = image[top:top + output_size, left:left + output_size]
-    if rng.rand() < 0.5:
+    if rng.rand() < 0.5 and apply_flip:
         crop = crop[:, ::-1]
     return crop
 
@@ -100,7 +104,8 @@ def train_crop_from_bytes(data: bytes, rng: np.random.RandomState,
                           output_size: int = DEFAULT_IMAGE_SIZE,
                           resize_side_min: int = RESIZE_SIDE_MIN,
                           resize_side_max: int = RESIZE_SIDE_MAX,
-                          use_native: bool = False) -> np.ndarray:
+                          use_native: bool = False,
+                          apply_flip: bool = True) -> np.ndarray:
     """VGG train preprocessing, uint8 end-to-end (standardization is the
     device's job — ops/augment.vgg_standardize): random resize side via a
     fused scaled decode, random crop, random flip.
@@ -121,7 +126,7 @@ def train_crop_from_bytes(data: bytes, rng: np.random.RandomState,
             rw, rh = _resized_dims(w0, h0, side)
             top = rng.randint(0, max(1, rh - output_size + 1))
             left = rng.randint(0, max(1, rw - output_size + 1))
-            flip = bool(rng.rand() < 0.5)
+            flip = bool(rng.rand() < 0.5) and apply_flip
             from .native_loader import decode_resize_crop_native
             out = decode_resize_crop_native(data, side, top, left,
                                             output_size, flip)
@@ -134,7 +139,8 @@ def train_crop_from_bytes(data: bytes, rng: np.random.RandomState,
                 crop = crop[:, ::-1]
             return np.ascontiguousarray(crop)
     image = decode_and_resize(data, side)
-    return np.ascontiguousarray(_random_crop_flip(image, rng, output_size))
+    return np.ascontiguousarray(
+        _random_crop_flip(image, rng, output_size, apply_flip))
 
 
 def eval_crop_from_bytes(data: bytes,

@@ -39,8 +39,8 @@ from .resilience.sentinel import train_with_nan_recovery
 from .telemetry import configure_from_config as _configure_telemetry
 from .telemetry.tracer import recorder as _flight_recorder
 from .train.hooks import (CheckpointHook, CorruptRecordsHook, GoodputHook,
-                          HeartbeatHook, InputStagesHook, LoggingHook,
-                          NanGuardHook, SummaryHook)
+                          HeartbeatHook, InputEchoHook, InputStagesHook,
+                          LoggingHook, NanGuardHook, SummaryHook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
                            resolve_checkpoint_dir, stacked_layout_stamp)
@@ -385,6 +385,9 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
         # input-pipeline stage attribution rides the summary cadence
         hooks.append(InputStagesHook(writer, cfg.train.summary_every_steps))
+        # data-echoing cache hit/miss/eviction telemetry (data/echo.py)
+        if cfg.data.echo_factor > 1:
+            hooks.append(InputEchoHook(writer, cfg.train.summary_every_steps))
         # corrupt-TFRecord tally (data.max_corrupt_records skips) likewise
         hooks.append(CorruptRecordsHook(writer, cfg.train.summary_every_steps))
         # goodput break-down (telemetry/goodput.py): compute vs input_wait
@@ -623,6 +626,9 @@ def run_train_and_eval(cfg: ExperimentConfig):
             hooks.append(SummaryHook(writer, cfg.train.summary_every_steps))
             hooks.append(InputStagesHook(writer,
                                          cfg.train.summary_every_steps))
+            if cfg.data.echo_factor > 1:
+                hooks.append(InputEchoHook(writer,
+                                           cfg.train.summary_every_steps))
             # corrupt-TFRecord tally exports here too — bit rot must be
             # visible in telemetry in every training mode
             hooks.append(CorruptRecordsHook(writer,

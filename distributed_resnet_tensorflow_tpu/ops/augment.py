@@ -87,12 +87,60 @@ def cifar_train_augment(images: jax.Array, rng: jax.Array,
 def vgg_standardize(images: jax.Array, rng: jax.Array = None) -> jax.Array:
     """ImageNet/VGG standardization on device: uint8 → x/255 − RGB means
     (reference vgg_preprocessing.py:37-39,196-227 — constant means, NOT
-    per-image moments). The random crop/flip/resize stay on the host (they
+    per-image moments). The random crop/resize stay on the host (they
     depend on per-image source geometry); moving just this float conversion
     on-device quarters the host→HBM transfer (uint8 vs f32) and removes the
     host's per-pixel float pass — the two costs that dominate a streamed
-    224² pipeline after the decode itself."""
+    224² pipeline after the decode itself. Eval/serve prep; the TRAIN path
+    is ``imagenet_train_augment`` (flip + standardize)."""
     del rng  # deterministic; matches the augment_fn(images, rng) contract
     from ..data.preprocessing import RGB_MEANS
     x = images.astype(jnp.float32) / 255.0
     return x - jnp.asarray(RGB_MEANS)
+
+
+def random_flip(images: jax.Array, rng: jax.Array) -> jax.Array:
+    """Per-image random horizontal flip (a width-reversed select — no
+    gather, no matmul). Output dtype follows the input."""
+    flip = jax.random.bernoulli(rng, 0.5, (images.shape[0],))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :],
+                     images)
+
+
+def imagenet_train_augment(images: jax.Array, rng: jax.Array,
+                           pad: int = 0) -> jax.Array:
+    """ImageNet TRAIN augmentation for raw uint8 NHWC crops, on device:
+    random horizontal flip (+ optional ``pad``-pixel random-crop jitter)
+    then the VGG standardize. The host decode keeps the reference's random
+    resize/crop (tied to per-image source geometry) and SKIPS its flip
+    when this path is active (data/imagenet.py ``device_flip``), so at
+    pad=0 the train distribution is exactly the reference's
+    resize → crop → flip → standardize with the flip and the float pass
+    moved on device. ``pad`` > 0 (data.augment_pad) adds a CIFAR-style
+    pad/crop jitter via the MXU-shaped one-hot matmuls of
+    ``random_crop_flip`` — spatial diversity for echoed appearances of
+    one decoded crop (data/echo.py). Draws are per appearance: the same
+    staged sample augments differently every time it feeds a step, which
+    is what keeps data echoing from replaying identical batches."""
+    from ..data.preprocessing import RGB_MEANS
+    if pad > 0:
+        x = random_crop_flip(images, rng, pad)  # float32, pixel scale
+    else:
+        x = random_flip(images, rng).astype(jnp.float32)
+    return x / 255.0 - jnp.asarray(RGB_MEANS)
+
+
+def device_augment_fn(kind: str, pad: int = 0):
+    """Resolve a HASHABLE device-augment spec — ``(leaf, kind, pad)`` is
+    what the CoalescedStager's fused unpack (parallel/sharding.py) and the
+    static elaborator cache/trace on — into the ``fn(images, rng)``
+    callable. One resolution point so the fused-unpack path, the step-side
+    path and the analysis gate can never disagree about what a spec
+    means."""
+    if kind == "imagenet_train":
+        return lambda images, rng: imagenet_train_augment(images, rng, pad)
+    if kind == "imagenet_eval":
+        return vgg_standardize
+    if kind == "cifar_train":
+        return lambda images, rng: cifar_train_augment(images, rng, pad or 4)
+    raise ValueError(f"unknown device augment kind {kind!r}")

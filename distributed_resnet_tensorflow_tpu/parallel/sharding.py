@@ -328,15 +328,47 @@ def _device_batch_shards(mesh: Mesh):
     return out
 
 
+def _staging_fields(spec: Tuple, batch_axis: int, b_local: int, pb: int,
+                    with_seed: bool):
+    """Byte layout of one batch spec inside a per-device staging region:
+    ``(fields, region_nbytes, seed_off)``. ``with_seed`` reserves a
+    trailing 4-byte slot for the fused-augment RNG counter (see
+    ``_build_unpack``) so the per-batch augmentation key rides the ONE
+    coalesced transfer instead of costing a second host→device hop.
+    Shared by the live ``_StagingLayout`` and the allocation-free
+    ``abstract_staged_unpack`` gate path — the two must lay bytes out
+    identically or the gate would trace a different program than
+    production runs."""
+    fields = []
+    off = 0
+    for key, shape, dtype in spec:
+        if len(shape) <= batch_axis or shape[batch_axis] != b_local:
+            raise ValueError(
+                f"leaf {key!r} shape {shape} does not carry the batch "
+                f"dim {b_local} on axis {batch_axis}")
+        rest = shape[batch_axis + 1:]
+        k_steps = shape[0] if batch_axis == 1 else 1
+        nbytes = pb * int(np.prod(rest, dtype=np.int64)) \
+            * k_steps * dtype.itemsize
+        fields.append((key, shape, dtype, off, int(nbytes)))
+        off += (int(nbytes) + 7) // 8 * 8  # 8-byte-align every leaf
+    seed_off = None
+    if with_seed:
+        seed_off = off
+        off += 8
+    return tuple(fields), off, seed_off
+
+
 class _StagingLayout:
     """Byte layout of one batch spec inside the coalesced staging buffer,
     plus its reusable host ring and compiled device-side unpack."""
 
     __slots__ = ("fields", "region_nbytes", "ring_buf", "inflight", "slot",
-                 "unpack", "pb", "batch_axis")
+                 "unpack", "pb", "batch_axis", "seed_off")
 
     def __init__(self, mesh: Mesh, spec: Tuple, stacked: bool, ring: int,
-                 shards):
+                 shards, augment: Optional[Tuple] = None,
+                 augment_seed: int = 0):
         self.batch_axis = 1 if stacked else 0
         n_shards = batch_shard_count_total(mesh)
         n_local = len({s for _, s in shards})
@@ -346,31 +378,24 @@ class _StagingLayout:
                 f"local batch {b_local} not divisible by this process's "
                 f"{n_local} batch shards")
         self.pb = b_local // n_local
-        fields = []
-        off = 0
-        for key, shape, dtype in spec:
-            if len(shape) <= self.batch_axis or \
-                    shape[self.batch_axis] != b_local:
-                raise ValueError(
-                    f"leaf {key!r} shape {shape} does not carry the batch "
-                    f"dim {b_local} on axis {self.batch_axis}")
-            rest = shape[self.batch_axis + 1:]
-            k_steps = shape[0] if stacked else 1
-            nbytes = self.pb * int(np.prod(rest, dtype=np.int64)) \
-                * k_steps * dtype.itemsize
-            fields.append((key, shape, dtype, off, int(nbytes)))
-            off += (int(nbytes) + 7) // 8 * 8  # 8-byte-align every leaf
-        self.fields = tuple(fields)
-        self.region_nbytes = off
-        self.ring_buf = np.empty((ring, len(shards), off), np.uint8)
+        self.fields, self.region_nbytes, self.seed_off = _staging_fields(
+            spec, self.batch_axis, b_local, self.pb, augment is not None)
+        self.ring_buf = np.empty((ring, len(shards), self.region_nbytes),
+                                 np.uint8)
         self.inflight: list = [None] * ring
         self.slot = 0
         self.unpack = _build_unpack(mesh, self.fields, stacked, n_shards,
-                                    self.pb)
+                                    self.pb, augment=augment,
+                                    seed_off=self.seed_off,
+                                    augment_seed=augment_seed)
 
-    def pack(self, batch, shards, lo_shard: int):
+    def pack(self, batch, shards, lo_shard: int, ctr: int = 0):
         """Copy each device's rows of every leaf into its staging region
-        (one host memcpy pass); returns (slot, per-device uint8 views)."""
+        (one host memcpy pass); returns (slot, per-device uint8 views).
+        ``ctr`` is the stager's put counter — written into every shard's
+        seed slot when the layout carries a fused augment, so the unpack
+        program derives a fresh per-batch RNG key from the staged bytes
+        themselves."""
         slot = self.slot
         self.slot = (slot + 1) % len(self.inflight)
         prev = self.inflight[slot]
@@ -388,6 +413,10 @@ class _StagingLayout:
                 src = batch[key][:, r0:r1] if stacked else batch[key][r0:r1]
                 dst = buf[di, off:off + nbytes].view(dtype)
                 np.copyto(dst.reshape(src.shape), src)
+        if self.seed_off is not None:
+            seed_bytes = np.frombuffer(
+                np.uint32(ctr & 0xFFFFFFFF).tobytes(), np.uint8)
+            buf[:, self.seed_off:self.seed_off + 4] = seed_bytes
         # (1, region) row views: the per-device shard shape of the global
         # (n_shards, region) flat array
         return slot, [buf[di:di + 1] for di in range(len(shards))]
@@ -405,15 +434,29 @@ _UNPACK_LOCK = threading.Lock()
 
 
 def _build_unpack(mesh: Mesh, fields: Tuple, stacked: bool, n_shards: int,
-                  pb: int):
+                  pb: int, augment: Optional[Tuple] = None,
+                  seed_off: Optional[int] = None, augment_seed: int = 0):
     """Compile flat (n_shards, region_bytes) uint8 → the batch pytree.
 
     Each leaf is sliced out of its shard's region, bitcast to its dtype and
-    reshaped back; the shard axis merges into the batch dim. All slicing is
-    shard-local, so XLA lowers this to per-device copies — no collectives.
+    reshaped back; the shard axis merges into the batch dim. The slicing is
+    shard-local, so XLA lowers it to per-device copies — no collectives.
+
+    ``augment`` = (leaf_name, kind, pad) — a hashable spec resolved by
+    ``ops.augment.device_augment_fn`` — FUSES the device-side train
+    augmentation into this same program: the named leaf (raw uint8 crops)
+    comes out flipped/jittered/standardized float32, so augmentation costs
+    no extra dispatch and runs exactly once per staged batch. Its RNG key
+    derives from a per-put counter embedded in the staged bytes at
+    ``seed_off`` (see ``_StagingLayout.pack``) — fresh draws per batch
+    with still exactly ONE host→device transfer. Reading that counter
+    broadcasts 4 bytes from shard 0 (the one non-shard-local access);
+    the augment ops themselves are batch-elementwise and stay shard-local
+    under GSPMD. Like the rest of the program, a fused-augment unpack is a
+    multi-device execution: consumer-thread dispatch only (StagedBatch).
     """
     from .mesh import data_sharding
-    key = (fields, stacked)
+    key = (fields, stacked, augment, augment_seed)
     with _UNPACK_LOCK:
         per_mesh = _UNPACK_CACHE.get(mesh)
         if per_mesh is None:
@@ -453,6 +496,26 @@ def _build_unpack(mesh: Mesh, fields: Tuple, stacked: bool, n_shards: int,
             else:
                 val = val.reshape((n_shards * pb,) + rest)
             out[name] = val
+        if augment is not None:
+            from ..ops.augment import device_augment_fn
+            leaf_name, kind, pad = augment
+            fn = device_augment_fn(kind, pad)
+            seg = jax.lax.slice(flat, (0, seed_off), (1, seed_off + 4))
+            ctr = jax.lax.bitcast_convert_type(seg.reshape((4,)),
+                                               jnp.uint32)
+            akey = jax.random.fold_in(
+                jax.random.PRNGKey(augment_seed), ctr)
+            img = out[leaf_name]
+            if stacked:
+                # one key per scan step of the fused-loop group, applied
+                # with lax.map so the float32 intermediate is one
+                # microbatch at a time, not the whole (K, B, ...) group
+                keys = jax.random.split(akey, img.shape[0])
+                img = jax.lax.map(lambda kv: fn(kv[0], kv[1]),
+                                  (img, keys))
+            else:
+                img = fn(img, akey)
+            out[leaf_name] = img
         return out
 
     out_sh = {name: leaf_sh for name, *_ in fields}
@@ -460,6 +523,41 @@ def _build_unpack(mesh: Mesh, fields: Tuple, stacked: bool, n_shards: int,
     with _UNPACK_LOCK:
         per_mesh[key] = jitted
     return jitted
+
+
+def abstract_staged_unpack(mesh: Mesh, batch_shapes: Dict,
+                           stacked: bool = False,
+                           augment: Optional[Tuple] = None,
+                           augment_seed: int = 0):
+    """Trace the coalesced unpack(+fused augment) program ABSTRACTLY —
+    zero allocation, zero compile — and return its output
+    ShapeDtypeStructs. The static-elaboration gate (analysis/elaborate.py)
+    calls this per preset so an unpack or fused-augment program that
+    cannot trace is a pre-submit finding, not a step-1 crash on the
+    cluster. ``batch_shapes`` maps leaf name → ShapeDtypeStruct exactly
+    as the host iterator would deliver the batch."""
+    spec = tuple(sorted(
+        (k, tuple(v.shape), np.dtype(v.dtype))
+        for k, v in batch_shapes.items()))
+    shards = _device_batch_shards(mesh)
+    if not shards:
+        raise ValueError("no addressable devices on this process")
+    n_local = len({s for _, s in shards})
+    batch_axis = 1 if stacked else 0
+    b_local = spec[0][1][batch_axis]
+    if b_local % n_local:
+        raise ValueError(
+            f"local batch {b_local} not divisible by this process's "
+            f"{n_local} batch shards")
+    pb = b_local // n_local
+    fields, region, seed_off = _staging_fields(
+        spec, batch_axis, b_local, pb, augment is not None)
+    n_shards = batch_shard_count_total(mesh)
+    unpack = _build_unpack(mesh, fields, stacked, n_shards, pb,
+                           augment=augment, seed_off=seed_off,
+                           augment_seed=augment_seed)
+    return jax.eval_shape(
+        unpack, jax.ShapeDtypeStruct((n_shards, region), np.uint8))
 
 
 class StagedBatch:
@@ -524,14 +622,27 @@ class CoalescedStager:
     Stage counters: pack time → "stage", transfer issue → "transfer"
     (``records_stages`` tells device_prefetch to only add its completion
     wait, not re-count items).
+
+    ``augment`` = (leaf_name, kind, pad): fuse the device-side train
+    augmentation for that leaf into the unpack program (see
+    ``_build_unpack``) — the imagenet flip/jitter/standardize runs inside
+    the one XLA program that already unpacks the staged uint8 buffer,
+    drawing fresh RNG per put via a counter embedded in the staged bytes.
+    Train-path stagers only: an augmenting stager must never serve eval
+    or serving batches (Trainer keeps separate neutral stagers for
+    those).
     """
 
     records_stages = True
 
-    def __init__(self, mesh: Mesh, stacked: bool = False, ring: int = 3):
+    def __init__(self, mesh: Mesh, stacked: bool = False, ring: int = 3,
+                 augment: Optional[Tuple] = None, augment_seed: int = 0):
         self.mesh = mesh
         self.stacked = stacked
         self.ring = max(2, ring)
+        self.augment = augment
+        self.augment_seed = augment_seed
+        self._put_ctr = 0
         self._lock = threading.Lock()
         self._layouts: Dict[Tuple, _StagingLayout] = {}
         self._shards = _device_batch_shards(mesh)
@@ -564,9 +675,14 @@ class CoalescedStager:
             layout = self._layouts.get(spec)
             if layout is None:
                 layout = _StagingLayout(self.mesh, spec, self.stacked,
-                                        self.ring, self._shards)
+                                        self.ring, self._shards,
+                                        augment=self.augment,
+                                        augment_seed=self.augment_seed)
                 self._layouts[spec] = layout
-            slot, views = layout.pack(batch, self._shards, self._lo_shard)
+            ctr = self._put_ctr
+            self._put_ctr += 1
+            slot, views = layout.pack(batch, self._shards, self._lo_shard,
+                                      ctr)
             t1 = time.perf_counter()
             nbytes = len(views) * layout.region_nbytes
             input_stages.add("stage", t1 - t0, items=items, nbytes=nbytes)

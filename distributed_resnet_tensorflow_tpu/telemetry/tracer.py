@@ -49,7 +49,7 @@ log = logging.getLogger(__name__)
 
 #: bump when the trace.json event shape changes (consumers key on it via
 #: the ``trace_dump`` metrics row and the file's otherData block)
-SPAN_SCHEMA_VERSION = 1
+SPAN_SCHEMA_VERSION = 2  # 2: + input.echo (data echoing, round 9)
 
 #: every span name the framework emits — register HERE first (the
 #: registry-drift rule rejects unregistered ``span("...")`` literals, the
@@ -58,6 +58,9 @@ SPAN_CATALOG = {
     # input pipeline (data/device_prefetch.py, data/imagenet.py)
     "input.decode": "one image decoded + cropped (decode worker thread)",
     "input.stack": "K host batches drawn + np.stack'ed (stacker thread)",
+    "input.echo": "one source batch absorbed into the decoded-sample echo "
+                  "cache (data/echo.py; emission busy time rides the "
+                  "'echo' stage counter)",
     "input.stage": "host batch packed/staged by the put path (staging "
                    "thread; CoalescedStager pack + issue)",
     "input.transfer": "wait for the previous batch's H2D transfer to "

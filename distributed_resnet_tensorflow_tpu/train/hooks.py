@@ -139,6 +139,31 @@ class InputStagesHook(_CadenceHook):
                                     {"step": int(step), "stages": snap})
 
 
+class InputEchoHook(_CadenceHook):
+    """Export the data-echoing cache counters (utils.metrics.echo_stats:
+    decoded/emitted/hits/evictions + cache bytes) to metrics.jsonl as
+    typed ``{"event": "input_echo"}`` rows every N steps — the telemetry
+    bench.py's imagenet_input row and docs/input_pipeline.md read for the
+    echo hit rate. Counters are cumulative, like input_stages; rows are
+    only written once the echo path has actually served something (a run
+    with echo_factor=1 emits nothing)."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        from ..utils.metrics import echo_stats
+        snap = echo_stats.snapshot()
+        if snap["emitted"]:
+            self.writer.write_event("input_echo",
+                                    {"step": int(step), **snap})
+
+
 class GoodputHook(_CadenceHook):
     """Export the goodput classification (telemetry/goodput.py) to
     metrics.jsonl as ``{"event": "goodput"}`` rows every N steps: per-

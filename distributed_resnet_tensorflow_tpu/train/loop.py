@@ -318,20 +318,56 @@ class Trainer:
                 f"optimizer.name={cfg.optimizer.name!r} (decoupled decay "
                 "is applied inside the optimizer)")
         self.tx = create_optimizer(cfg.optimizer, self.schedule)
+        ct = cfg.data.coalesced_transfer
+        if ct not in ("auto", "on", "off"):
+            raise ValueError(f"unknown coalesced_transfer setting {ct!r}")
+        if ct == "auto":
+            # like data.device_augment: auto = on iff a real accelerator is
+            # attached. Coalescing exists to amortize per-call transfer
+            # overhead on a device link; on the CPU backend (tests, tiny
+            # local runs) the extra pack/unpack per batch only costs
+            ct = "off" if jax.default_backend() == "cpu" else "on"
+        self._coalesced = ct == "on"
         from ..data import device_augment_enabled
         aug_fn = None
+        # (leaf, kind, pad) when the imagenet train augmentation FUSES into
+        # the CoalescedStager's unpack program (parallel/sharding.py): one
+        # XLA program unpacks the staged uint8 bytes AND flips/jitters/
+        # standardizes them, keyed per staged batch. Requires the stager,
+        # and is OFF under data.echo_transfer > 1: transfer reuse re-runs
+        # the STEP on one staged batch, so the augment must draw inside
+        # the step (step-keyed RNG) to stay fresh per reuse.
+        self._train_augment_spec = None
         # Only the iterator/step contract decides who augments. A streamed
         # iterator with device_augment off yields host-augmented float32, so
         # forcing the device path here would double-augment; when a device
         # dataset (raw uint8 in HBM) is actually attached,
         # attach_device_dataset forces the augment step on itself.
         if device_augment_enabled(cfg, "train"):
+            from ..ops.augment import device_augment_fn
             if cfg.data.dataset == "imagenet":
-                from ..ops.augment import vgg_standardize
-                aug_fn = vgg_standardize
+                spec = ("images", "imagenet_train", cfg.data.augment_pad)
+                if self._coalesced and cfg.data.echo_transfer <= 1:
+                    self._train_augment_spec = spec
+                else:
+                    aug_fn = device_augment_fn(spec[1], spec[2])
             else:
                 from ..ops.augment import cifar_train_augment
                 aug_fn = cifar_train_augment
+        if cfg.data.echo_transfer > 1 and aug_fn is None \
+                and self._train_augment_spec is None:
+            # without device-side augmentation a reused dispatch repeats
+            # the SAME pixels: k>1 still reshuffles batch composition on
+            # device, but k=1 reuses are bit-identical replays — probably
+            # not what the operator meant by echoing
+            import logging
+            logging.getLogger(__name__).warning(
+                "data.echo_transfer=%d with no device-side augmentation "
+                "(device_augment resolved off): reused dispatches repeat "
+                "identical samples (steps_per_loop=1: identical batches). "
+                "Enable data.device_augment, or prefer data.echo_factor "
+                "(host echo reshuffles every batch)",
+                cfg.data.echo_transfer)
         self._aug_fn = aug_fn
         self._cfg_aug_fn = aug_fn  # the config-resolved choice, for detach
         self._train_step = self._build_train_step(aug_fn)
@@ -361,16 +397,7 @@ class Trainer:
         # loop — eval makes no optimizer-step progress, and without ticks a
         # long eval round would read as a wedged process
         self.heartbeat = None
-        ct = cfg.data.coalesced_transfer
-        if ct not in ("auto", "on", "off"):
-            raise ValueError(f"unknown coalesced_transfer setting {ct!r}")
-        if ct == "auto":
-            # like data.device_augment: auto = on iff a real accelerator is
-            # attached. Coalescing exists to amortize per-call transfer
-            # overhead on a device link; on the CPU backend (tests, tiny
-            # local runs) the extra pack/unpack per batch only costs
-            ct = "off" if jax.default_backend() == "cpu" else "on"
-        if ct == "on":
+        if self._coalesced:
             # coalesced staging (parallel/sharding.CoalescedStager): one
             # contiguous ring-buffered host region per device, a single
             # device_put issue per batch, per-shard placement via
@@ -382,19 +409,38 @@ class Trainer:
                                               ring=ring)
             self._put_multi_batch = CoalescedStager(self.mesh, stacked=True,
                                                     ring=ring)
-        elif jax.process_count() > 1:
-            # per-leaf fallback. single-process: device_put the full batch
-            # sharded; multi-process: every process contributes its local
-            # shard of the global array
-            from ..parallel.sharding import make_global_stacked_batch
-            self._put_batch = lambda b: make_global_batch(b, self.mesh)
-            self._put_multi_batch = \
-                lambda b: make_global_stacked_batch(b, self.mesh)
+            if self._train_augment_spec is not None:
+                # TRAIN-only stagers whose unpack program fuses the
+                # device augmentation; eval/serve keep the neutral
+                # stagers above (an augmenting put must never touch
+                # their batches)
+                self._put_train_batch = CoalescedStager(
+                    self.mesh, stacked=False, ring=ring,
+                    augment=self._train_augment_spec,
+                    augment_seed=cfg.train.seed)
+                self._put_train_multi_batch = CoalescedStager(
+                    self.mesh, stacked=True, ring=ring,
+                    augment=self._train_augment_spec,
+                    augment_seed=cfg.train.seed)
+            else:
+                self._put_train_batch = self._put_batch
+                self._put_train_multi_batch = self._put_multi_batch
         else:
-            from ..parallel.sharding import shard_stacked_batch
-            self._put_batch = lambda b: shard_batch(b, self.mesh)
-            self._put_multi_batch = \
-                lambda b: shard_stacked_batch(b, self.mesh)
+            if jax.process_count() > 1:
+                # per-leaf fallback. single-process: device_put the full
+                # batch sharded; multi-process: every process contributes
+                # its local shard of the global array
+                from ..parallel.sharding import make_global_stacked_batch
+                self._put_batch = lambda b: make_global_batch(b, self.mesh)
+                self._put_multi_batch = \
+                    lambda b: make_global_stacked_batch(b, self.mesh)
+            else:
+                from ..parallel.sharding import shard_stacked_batch
+                self._put_batch = lambda b: shard_batch(b, self.mesh)
+                self._put_multi_batch = \
+                    lambda b: shard_stacked_batch(b, self.mesh)
+            self._put_train_batch = self._put_batch
+            self._put_train_multi_batch = self._put_multi_batch
 
     def _build_train_step(self, aug_fn):
         cfg = self.cfg
@@ -435,16 +481,48 @@ class Trainer:
                 donate_argnums=(0,))
         return self._jitted_train
 
+    @property
+    def train_put_augments(self) -> bool:
+        """True when the train put path's unpack program carries the fused
+        device augmentation (so train batches come out float32 and the
+        step itself has no augment op) — bench and tests size their probe
+        batches by this."""
+        return self._train_augment_spec is not None
+
     def jitted_multi_step(self, k: int = 0):
         """Fused optimizer steps per dispatch: lax.scan over stacked batches
         (the step count comes from the input's leading axis; ``k`` is
-        documentation only). Returns (state, metrics-of-last-step)."""
+        documentation only). Returns (state, metrics-of-last-step).
+
+        With ``data.echo_transfer`` > 1 the program starts by reshuffling
+        the group's batch composition with a step-keyed on-device
+        permutation over the flattened K×B samples: each REUSE of one
+        staged group (train() dispatches it echo_transfer times) trains on
+        differently-composed batches — the transfer-level echo's analog of
+        the host echo cache's per-echo reshuffle, at zero extra
+        host→device traffic."""
         del k
         if self._jitted_multi is None:
             step = self._train_step
             unroll = max(1, self.cfg.train.scan_unroll)
+            reshuffle = self.cfg.data.echo_transfer > 1
+            perm_seed = self.cfg.train.seed + 0x5EED
 
             def multi(state, batches):
+                if reshuffle:
+                    lead = batches["labels"].shape
+                    kb = lead[0] * lead[1]
+                    perm = jax.random.permutation(
+                        jax.random.fold_in(jax.random.PRNGKey(perm_seed),
+                                           state.step), kb)
+
+                    def resh(x):
+                        flat = x.reshape((kb,) + x.shape[2:])
+                        return jnp.take(flat, perm,
+                                        axis=0).reshape(x.shape)
+
+                    batches = jax.tree_util.tree_map(resh, batches)
+
                 def body(s, batch):
                     s, m = step(s, batch)
                     return s, m
@@ -490,8 +568,17 @@ class Trainer:
         if jax.process_count() > 1:
             raise ValueError("device dataset requires a single process")
         if self._aug_fn is None:
-            from ..ops.augment import cifar_train_augment
-            self._aug_fn = cifar_train_augment
+            # the idx path bypasses the put stagers, so a FUSED train
+            # augmentation (carried by the stager's unpack, step aug_fn
+            # None) must move back into the step — and it must be the
+            # config's own augmentation, not the cifar default, or an
+            # imagenet Trainer would train on cifar-normalized pixels
+            from ..ops.augment import device_augment_fn
+            if self._train_augment_spec is not None:
+                _, kind, pad = self._train_augment_spec
+                self._aug_fn = device_augment_fn(kind, pad)
+            else:
+                self._aug_fn = device_augment_fn("cifar_train")
             self._train_step = self._build_train_step(self._aug_fn)
             self._jitted_train = None
             self._jitted_multi = None
@@ -560,9 +647,12 @@ class Trainer:
             return profiling.flops_per_step(
                 self._jitted_idx_raw, self.state, self._put_idx(batch),
                 *self._dev_data)
+        # the TRAIN put path: with the fused-augment stager the step's
+        # traced program expects the unpack's augmented float32 images,
+        # and the counted FLOPs then include the on-device augmentation
         return profiling.flops_per_step(
             self.jitted_train_step(), self.state,
-            finalize_staged(self._put_batch(batch)))
+            finalize_staged(self._put_train_batch(batch)))
 
     def jitted_index_multi_step(self, k: int = 0):
         del k
@@ -672,9 +762,18 @@ class Trainer:
         # device-resident dataset: data_iter carries {"idx"} batches; the
         # step gathers images/labels from HBM (attach_device_dataset)
         use_idx = self._dev_data is not None
-        put_one = self._put_idx if use_idx else self._put_batch
-        put_multi = self._put_idx_multi if use_idx else self._put_multi_batch
+        put_one = self._put_idx if use_idx else self._put_train_batch
+        put_multi = self._put_idx_multi if use_idx \
+            else self._put_train_multi_batch
         depth = max(1, self.cfg.data.transfer_depth)
+        # transfer-level data echoing (data.echo_transfer > 1): each staged
+        # batch (group) is dispatched `reuse` times before the next draw —
+        # one H2D transfer feeds reuse × k steps. The fused path reshuffles
+        # batch composition per dispatch on device (jitted_multi_step) and
+        # the step-keyed device augmentation re-draws per step, so reuses
+        # are not replays. The index path never reuses (the device dataset
+        # ships only indices — there is no transfer to amortize).
+        reuse = 1 if use_idx else max(1, self.cfg.data.echo_transfer)
         if k == 1:
             from ..data.device_prefetch import device_prefetch
             step_fn = self.jitted_index_step() if use_idx \
@@ -691,17 +790,22 @@ class Trainer:
                     data_iter,
                     device_prefetch(iter(data_iter), put_one, depth=depth))
             dev_iter = self._dev_prefetch[1]
+            batch = None
+            batch_uses = 0
             for step in range(start_step, num_steps):
-                try:
-                    # flight-recorder + goodput: time blocked on input
-                    # (telemetry/; the span is ~2 clock reads when enabled,
-                    # a shared no-op otherwise)
-                    with span("input.wait", category="input_wait"):
-                        batch = next(dev_iter)
-                except StopIteration:
-                    # finite stream exhausted: end training cleanly, same
-                    # contract as the fused k>1 path
-                    return self.state, metrics
+                if batch_uses <= 0:
+                    try:
+                        # flight-recorder + goodput: time blocked on input
+                        # (telemetry/; the span is ~2 clock reads when
+                        # enabled, a shared no-op otherwise)
+                        with span("input.wait", category="input_wait"):
+                            batch = next(dev_iter)
+                    except StopIteration:
+                        # finite stream exhausted: end training cleanly,
+                        # same contract as the fused k>1 path
+                        return self.state, metrics
+                    batch_uses = reuse
+                batch_uses -= 1
                 with span("train.step"):
                     self.state, metrics = step_fn(self.state, batch)
                 for h in hooks:
@@ -773,11 +877,16 @@ class Trainer:
                     stacked = next(stacked_iter)
             except StopIteration:
                 return self.state, metrics
-            with span("train.step"):
-                self.state, metrics = multi_fn(self.state, stacked)
-            step += k
-            for h in hooks:
-                h(step, self.state, metrics)
+            for _r in range(reuse):
+                if step + k > num_steps:
+                    break
+                with span("train.step"):
+                    self.state, metrics = multi_fn(self.state, stacked)
+                step += k
+                for h in hooks:
+                    h(step, self.state, metrics)
+                if _r + 1 < reuse and stop_fn is not None and stop_fn():
+                    return self.state, metrics
         # 3) tail shorter than k: draw one more group, run the first
         # (num_steps - step) unfused, bank the remainder for the next
         # segment. Never touch data_iter directly — the stacker's worker

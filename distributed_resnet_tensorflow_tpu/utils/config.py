@@ -92,12 +92,45 @@ class DataConfig:
     image_size: int = 32              # 32 cifar, 224 imagenet (reference resnet_imagenet_main.py image_size flag)
     shuffle_buffer: int = 50000       # full-epoch CIFAR shuffle (reference resnet_cifar_main.py:221)
     prefetch_batches: int = 2         # reference prefetches 2*bs samples (resnet_cifar_main.py:232)
-    num_parallel_calls: int = 8
+    # imagenet decode THREAD pool width; -1 = auto (min(8, host cores,
+    # floor 4) — data.resolve_decode_workers, the single resolution point)
+    num_parallel_calls: int = -1
     use_native_loader: bool = False   # C++ threaded loader (native/)
     # >0: decode in worker PROCESSES instead of threads (imagenet) — full
     # GIL independence at the price of queue pickling; the measured
-    # thread-vs-process scaling story is docs/input_scaling_r4.json
-    decode_processes: int = 0
+    # thread-vs-process scaling story is docs/input_scaling_r4.json.
+    # -1 = auto: min(8, host cores) processes on hosts with >2 cores, else
+    # 0 (threads — a process pool below that only adds pickling); 0 =
+    # explicit threads-only. Explicit settings always win over auto.
+    decode_processes: int = -1
+    # -- data echoing + decoded-sample cache (data/echo.py) --------------
+    # >1: each decoded sample feeds this many training batches overall —
+    # samples enter a bounded host cache of decoded uint8 crops and every
+    # emitted batch is a fresh seeded reshuffle of the cache, so one JPEG
+    # decode feeds echo_factor steps (arXiv:1811.05233's input-bound
+    # regime). Train-mode streams only; 1 = off
+    echo_factor: int = 1
+    # byte bound on the decoded-sample cache; overflowing samples are
+    # evicted oldest-first (counted — {"event": "input_echo"} rows) even
+    # if they still had echo uses left: the memory bound wins
+    echo_cache_mb: float = 256.0
+    # >1: re-dispatch each staged device-resident batch group this many
+    # times before drawing the next — ONE host→device transfer feeds
+    # echo_transfer × steps_per_loop optimizer steps. Each reuse
+    # reshuffles the group's batch composition on device (seeded
+    # permutation inside the jitted multi-step) and re-draws the device
+    # augmentation (step-keyed RNG), so echoed steps stay diverse. The
+    # lever past the H2D link ceiling (BENCH_r05: 49 MB/s moves only
+    # ~326 uint8 img/s); composes with echo_factor (total echo =
+    # echo_factor × echo_transfer decodes saved per step). 1 = off
+    echo_transfer: int = 1
+    # imagenet on-device augmentation: random-crop jitter padding in
+    # pixels (ops/augment.imagenet_train_augment). 0 = flip + VGG
+    # standardize only (reference-faithful distribution: the host decode
+    # keeps its random resize/crop, the device takes over the flip and
+    # the float pass); >0 adds a CIFAR-style pad/crop jitter so echoed
+    # appearances of one decoded crop also differ spatially
+    augment_pad: int = 0
     # train-time device-side input work (ops/augment.py), auto = on iff TPU.
     # cifar*: crop/flip/standardize inside the jitted step; imagenet: the
     # VGG standardize only (iterator then ships raw uint8 crops) — see
@@ -114,11 +147,13 @@ class DataConfig:
     # real accelerator (per-call transfer overhead is what it amortizes)
     coalesced_transfer: str = "auto"  # auto | on | off
     # device-resident batches the dedicated transfer thread keeps queued
-    # ahead of dispatch (data/device_prefetch.device_prefetch)
-    transfer_depth: int = 2
+    # ahead of dispatch (data/device_prefetch.device_prefetch). Raised
+    # 2 → 3 with the double-buffered transfer issue (round 9): the staging
+    # thread now packs batch N+1 while N's transfer is still in flight
+    transfer_depth: int = 3
     # reused host staging buffers; must cover the transfers in flight
-    # (transfer_depth + the one behind the current put)
-    staging_ring: int = 4
+    # (transfer_depth + the two behind the double-buffered issue point)
+    staging_ring: int = 6
     # tolerate this many corrupt/truncated TFRecord records per process
     # before raising (each skip is a counted warning + a
     # {"event": "corrupt_record"} metrics row — data/tfrecord.py); 0 =

@@ -94,13 +94,77 @@ class StageStats:
 
 
 # process-global input-pipeline telemetry: decode workers, the batch
-# stacker, the staging/transfer thread and the dispatch loop all feed this
-# one registry; InputStagesHook exports it to metrics.jsonl and bench.py
-# reads it for end-to-end attribution. Decode worker PROCESSES
-# (data.decode_processes > 0) accumulate in their own process and ship
-# counter snapshots back over the result queue; the parent merges them
-# here under per-worker keys (data/imagenet.py, docs/input_pipeline.md).
+# stacker, the echo cache, the staging/transfer thread and the dispatch
+# loop all feed this one registry; InputStagesHook exports it to
+# metrics.jsonl and bench.py reads it for end-to-end attribution. Decode
+# worker PROCESSES (data.decode_processes > 0) accumulate in their own
+# process and ship counter snapshots back over the result queue; the
+# parent merges them here under per-worker keys (data/imagenet.py,
+# docs/input_pipeline.md).
 input_stages = StageStats()
+
+
+class EchoStats:
+    """Thread-safe counters for the data-echoing decoded-sample cache
+    (data/echo.py): decoded (fresh samples inserted = cache misses),
+    emitted (samples served into batches), hits (servings of a sample
+    past its first — the decodes echoing saved), evictions (samples
+    dropped by the byte bound with echo uses still pending) and the lost
+    uses those evictions cost. ``InputEchoHook`` exports snapshots to
+    metrics.jsonl as ``{"event": "input_echo"}`` rows and bench.py's
+    imagenet_input row reads the same registry."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = dict(decoded=0, emitted=0, hits=0, evictions=0,
+                       lost_uses=0)
+        self.echo_factor = 1
+        self.cache_cap_bytes = 0
+        self.cache_bytes = 0
+        self.peak_cache_bytes = 0
+
+    def configure(self, echo_factor: int, cache_cap_bytes: int) -> None:
+        with self._lock:
+            self.echo_factor = int(echo_factor)
+            self.cache_cap_bytes = int(cache_cap_bytes)
+
+    def add(self, decoded: int = 0, emitted: int = 0, hits: int = 0,
+            evictions: int = 0, lost_uses: int = 0,
+            cache_bytes: Optional[int] = None) -> None:
+        with self._lock:
+            self._c["decoded"] += decoded
+            self._c["emitted"] += emitted
+            self._c["hits"] += hits
+            self._c["evictions"] += evictions
+            self._c["lost_uses"] += lost_uses
+            if cache_bytes is not None:
+                self.cache_bytes = int(cache_bytes)
+                self.peak_cache_bytes = max(self.peak_cache_bytes,
+                                            self.cache_bytes)
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in self._c:
+                self._c[k] = 0
+            self.cache_bytes = 0
+            self.peak_cache_bytes = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Counters + hit_rate (hits / emitted: the fraction of served
+        samples that did NOT cost a fresh decode)."""
+        with self._lock:
+            out = dict(self._c)
+            out["echo_factor"] = self.echo_factor
+            out["cache_cap_bytes"] = self.cache_cap_bytes
+            out["cache_bytes"] = self.cache_bytes
+            out["peak_cache_bytes"] = self.peak_cache_bytes
+        out["hit_rate"] = round(out["hits"] / out["emitted"], 4) \
+            if out["emitted"] else 0.0
+        return out
+
+
+# process-global echo-cache telemetry (one echoing stream per train run)
+echo_stats = EchoStats()
 
 
 #: The metrics.jsonl event registry — the ONE source of truth for every
@@ -127,6 +191,24 @@ EVENT_SCHEMAS = {
                       "max_thread_seconds, workers, bytes} — cumulative "
                       "since process start/reset (difference consecutive "
                       "rows for window rates)",
+        },
+    },
+    "input_echo": {
+        "emitted_by": "train/hooks.py InputEchoHook",
+        "fields": {
+            "step": "step at export time",
+            "echo_factor": "configured data.echo_factor",
+            "decoded": "fresh decoded samples inserted (cache misses) — "
+                       "cumulative, like the input_stages counters",
+            "emitted": "samples served into training batches",
+            "hits": "servings past a sample's first (decodes saved)",
+            "hit_rate": "hits / emitted",
+            "evictions": "samples evicted by the echo_cache_mb bound with "
+                         "echo uses still pending",
+            "lost_uses": "echo servings those evictions cost",
+            "cache_bytes": "decoded-sample cache size at export",
+            "peak_cache_bytes": "high-water cache size (bound witness)",
+            "cache_cap_bytes": "configured byte bound",
         },
     },
     "corrupt_record": {
