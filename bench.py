@@ -684,6 +684,174 @@ def bench_goodput(budget_left):
     }
 
 
+def bench_overlap(budget_left):
+    """The zero-stall step-loop row (ROADMAP open item 5; ISSUE 10): (a)
+    step time + goodput checkpoint share with checkpointing disabled vs
+    SYNC vs ASYNC at a live time cadence, plus a cadence sweep — the
+    acceptance bar is async checkpoint_pct ≤ 2% and mean step time within
+    5% of checkpointing-disabled; (b) the bucketed gradient-communication
+    A/B (comm.overlap off / on-bucketed / on-single-bucket) on a
+    multi-device mesh — run in-process when this backend has >1 device,
+    else in a subprocess with 8 virtual CPU devices (structure check +
+    honest CPU numbers; collectives only overlap for real on TPU/DCN)."""
+    import shutil
+
+    from distributed_resnet_tensorflow_tpu.checkpoint import CheckpointManager
+    from distributed_resnet_tensorflow_tpu.data import create_input_iterator
+    from distributed_resnet_tensorflow_tpu.telemetry import goodput
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.train.hooks import CheckpointHook
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+    from distributed_resnet_tensorflow_tpu.utils.metrics import (
+        ckpt_async_stats)
+
+    if budget_left() < 90:
+        return {"skipped": "over bench budget"}
+    out = {}
+    cfg = get_preset("cifar10_resnet50")
+    cfg.model.resnet_size = 20  # the classifier row's model: measures the
+    cfg.data.data_dir = _synth_cifar_files()  # machinery, not conv MFU
+    cfg.mesh.data = len(jax.devices())
+    trainer = Trainer(cfg)
+    trainer.init_state()
+    stream = create_input_iterator(cfg, mode="train")
+    trainer.train(stream, num_steps=5)  # warmup/compile
+    jax.block_until_ready(trainer.state.params)
+    step = 5
+
+    def measure(window, manager):
+        nonlocal step
+        hooks = (CheckpointHook(manager),) if manager is not None else ()
+        goodput.rebase()
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < window and n < 20_000:
+            trainer.train(stream, num_steps=step + 10, start_step=step,
+                          hooks=hooks)
+            step += 10
+            n += 10
+        if manager is not None:
+            manager.close()  # drain inside the timed window (honest)
+        jax.block_until_ready(trainer.state.params)
+        wall = time.perf_counter() - t0
+        itv = goodput.interval()
+        return {"steps": n, "steps_per_sec": round(n / wall, 2),
+                "checkpoint_pct": itv["pct"]["checkpoint"],
+                "checkpoint_secs": itv["seconds"]["checkpoint"],
+                "wall_secs": round(wall, 2)}
+
+    window = min(12.0, max(6.0, (budget_left() - 60) / 5))
+    ckpt_root = os.path.join(tempfile.gettempdir(), "drt_bench_overlap_ckpt")
+
+    def manager_for(mode, cadence):
+        d = os.path.join(ckpt_root, f"{mode}_{cadence}")
+        shutil.rmtree(d, ignore_errors=True)
+        return CheckpointManager(d, save_every_steps=0,
+                                 save_every_secs=cadence, max_to_keep=2,
+                                 async_save=(mode == "async"))
+
+    base = measure(window, None)
+    out["ckpt_disabled"] = base
+    cadence = max(2.0, window / 4)
+    out["ckpt_cadence_secs"] = round(cadence, 1)
+    out["ckpt_sync"] = measure(window, manager_for("sync", cadence))
+    ckpt_async_stats.reset()
+    out["ckpt_async"] = measure(window, manager_for("async", cadence))
+    out["ckpt_async"]["stats"] = ckpt_async_stats.snapshot()
+    out["async_step_time_vs_disabled"] = round(
+        base["steps_per_sec"] /
+        max(out["ckpt_async"]["steps_per_sec"], 1e-9), 3)
+    # cadence sweep: how the checkpoint share scales with save frequency
+    sweep = {}
+    for cad in (cadence / 2, cadence * 2):
+        if budget_left() < window + 30:
+            sweep[f"{cad:.1f}s"] = {"skipped": "over bench budget"}
+            continue
+        ckpt_async_stats.reset()
+        row = measure(window, manager_for("async", cad))
+        row["saves"] = ckpt_async_stats.snapshot()["saves"]
+        sweep[f"{cad:.1f}s"] = row
+    out["ckpt_cadence_sweep"] = sweep
+
+    # (b) bucketed gradient-exchange A/B
+    if budget_left() < 60:
+        out["bucketed"] = {"skipped": "over bench budget"}
+        return out
+    try:
+        if len(jax.devices()) > 1:
+            out["bucketed"] = _overlap_ab()
+        else:
+            # single-device backend (CPU smoke box): re-run under a
+            # virtual 8-device mesh in a subprocess — the XLA flag must
+            # be set before the backend initializes
+            import subprocess
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count=8")
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--overlap-ab"],
+                capture_output=True, text=True, env=env,
+                timeout=max(60, budget_left()))
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr[-300:])
+            out["bucketed"] = json.loads(proc.stdout.strip().splitlines()[-1])
+            out["bucketed"]["virtual_devices"] = 8
+    except Exception as e:
+        out["bucketed"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return out
+
+
+def _overlap_ab(n_steps: int = 20):
+    """comm.overlap off / bucketed / single-bucket step time on THIS
+    backend's devices (call with >1 device; bench_overlap re-launches
+    under virtual devices otherwise). Uses synthetic sharded batches
+    through the single-step jit so the row times the exchange, not the
+    input pipeline. On a real accelerator mesh the bucketed-vs-off delta
+    IS the hidden-communication win; on virtual CPU devices collectives
+    are memcpys and the row mostly witnesses structure + overhead. The
+    model stays small (rn8) so three multi-device compiles fit a smoke
+    box's budget."""
+    from distributed_resnet_tensorflow_tpu.parallel.overlap import (
+        overlap_stats)
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import (
+        shard_batch)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    rng = np.random.RandomState(0)
+    bs = 64
+    images = rng.randn(bs, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, (bs,)).astype(np.int32)
+    rows = {}
+    for label, overlap, bucket_mb in (("off", "off", 4.0),
+                                      ("bucketed", "on", 0.25),
+                                      ("single_bucket", "on", 4096.0)):
+        cfg = get_preset("cifar10_resnet50")
+        cfg.model.resnet_size = 8
+        cfg.train.batch_size = bs
+        cfg.comm.overlap = overlap
+        cfg.comm.bucket_mb = bucket_mb
+        cfg.mesh.data = len(jax.devices())
+        trainer = Trainer(cfg)
+        trainer.init_state()
+        step_fn = trainer.jitted_train_step()
+        batch = shard_batch({"images": images, "labels": labels},
+                            trainer.mesh)
+        state = trainer.state
+        for _ in range(3):  # compile + warm
+            state, _m = step_fn(state, batch)
+        jax.block_until_ready(state.params)
+        state, dt = _best_time(step_fn, state, [batch], n_steps, reps=3)
+        rows[label] = {"steps_per_sec": round(n_steps / dt, 2),
+                       "step_ms": round(dt / n_steps * 1000, 2)}
+        if overlap == "on":
+            rows[label]["plan"] = overlap_stats.snapshot()
+    rows["bucketed_vs_off"] = round(
+        rows["bucketed"]["steps_per_sec"] / rows["off"]["steps_per_sec"], 3)
+    return rows
+
+
 def bench_serving(budget_left):
     """The serving row (serve/; docs/serving.md): open-loop synthetic load
     against the AOT-compiled batched inference server — p50/p99 request
@@ -782,6 +950,11 @@ def main():
     prints even if a slow tunnel day would push the extra sections past an
     external timeout (a killed bench emits nothing, which is worse than a
     bench missing secondary sections)."""
+    if "--overlap-ab" in sys.argv:
+        # bench_overlap's multi-device re-entry (virtual 8-device CPU mesh
+        # via env XLA_FLAGS; single JSON line on stdout)
+        print(json.dumps(_overlap_ab()))
+        return
     t0 = time.monotonic()
     try:
         budget = float(os.environ.get("BENCH_BUDGET_SECS", "900"))
@@ -819,6 +992,9 @@ def main():
                     # before/after number for ROADMAP items 2 and 5
                     ("goodput_breakdown",
                      lambda: bench_goodput(budget_left)),
+                    # zero-stall step loop (ROADMAP item 5): async-vs-sync
+                    # checkpoint stall + the bucketed-exchange A/B
+                    ("overlap", lambda: bench_overlap(budget_left)),
                     ("imagenet_norm_contracts",
                      lambda: bench_imagenet_norm(budget_left))):
         if time.monotonic() - t0 > budget:

@@ -14,6 +14,10 @@ pushes shape/dtype-only values through:
     errors surface at trace time; the pp×ep MoE ``_SpecError`` of
     tests/test_pipeline.py was located exactly this way),
   * the eval step,
+  * the bucketed-overlap train step (``comm.overlap=on``,
+    parallel/overlap.py) for every layout inside its envelope — the
+    shard_map'd exchange traces per preset × layout so the knob can't
+    compile-crash on first cluster use,
   * the serve/predict step, once per batch bucket the inference server
     would AOT-compile (serve/compile_cache.bucket_sizes),
   * the coalesced staged-unpack program — with the fused on-device
@@ -259,6 +263,27 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
                 findings.append(_findings_from_exc(
                     "elab-serve-step", locus,
                     f"serve step (bucket {bucket})", e))
+
+        # bucketed-overlap train step (parallel/overlap.py): the
+        # comm.overlap=on variant of this preset × layout, traced
+        # abstractly — a shard_map spec/rank error, a bucket plan that
+        # cannot exchange a leaf, or a BN-axis mistake is a gate finding
+        # here, not a step-1 crash when an operator first flips the knob
+        # on a cluster. Only layouts inside the overlap envelope trace
+        # (dp / dp_fsdp on the conv/logistic families); the state shapes
+        # are reused — the axis-named model has an identical param tree.
+        try:
+            import copy
+            from ..parallel.overlap import overlap_unsupported_reason
+            if overlap_unsupported_reason(cfg, mesh) is None:
+                ocfg = copy.deepcopy(cfg)
+                ocfg.comm.overlap = "on"
+                otrainer = Trainer(ocfg, mesh=mesh)
+                batch = _abstract_batch(ocfg, ocfg.train.batch_size)
+                jax.eval_shape(otrainer._train_step, state_shapes, batch)
+        except Exception as e:
+            findings.append(_findings_from_exc("elab-overlap-step", locus,
+                                               "bucketed overlap step", e))
 
         # coalesced staged-unpack program (parallel/sharding._build_unpack)
         # — and, for imagenet presets, the FUSED on-device augmentation

@@ -7,7 +7,7 @@ Adding a rule: create the module, append it to ``ALL_RULES``, add a
 known-bad fixture to tests/test_analysis.py and a row to the catalog in
 docs/static_analysis.md.
 """
-from . import (bare_assert, cached_mesh, device_put, exit_codes,
+from . import (bare_assert, cached_mesh, ckpt_io, device_put, exit_codes,
                registry_drift)
 
 ALL_RULES = (
@@ -16,4 +16,5 @@ ALL_RULES = (
     bare_assert,
     exit_codes,
     registry_drift,
+    ckpt_io,
 )

@@ -1,0 +1,84 @@
+"""ckpt-io-thread: checkpoint I/O stays off the train-loop thread.
+
+The zero-stall checkpoint contract (docs/resilience.md, round 10): the
+step-loop thread's only checkpoint costs are the device→host snapshot and
+backpressure on an in-flight save — the stage/fsync/manifest/commit
+protocol runs on the dedicated writer thread (``CheckpointManager._write``,
+reached via ``_write_async``) or, on the deliberate sync path
+(multi-process saves, ``async_save=false``), through that same function.
+A durability call (``os.fsync``, ``fsync_dir``, ``write_manifest``, or a
+direct staging-path write) sprinkled anywhere else is dead device time
+the goodput meter would bill as a checkpoint stall — exactly the bucket
+this round drove to ~0 — and it dodges the writer's span/stat accounting
+(``checkpoint.writer``, the ``ckpt_async`` row).
+
+Allowed homes: ``resilience/manifest.py`` (the commit protocol itself)
+and, inside ``checkpoint/manager.py``, only the ``_write`` function (the
+writer entry). Deliberate exceptions carry
+``# shardcheck: ok(ckpt-io-thread)`` — e.g. the fault injector's marker
+fsync (resilience/faultinject.py), which runs on the writer thread by
+construction.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..report import Finding
+
+RULE_NAME = "ckpt-io-thread"
+DOC = __doc__
+
+ALLOWED_FILES = (
+    "distributed_resnet_tensorflow_tpu/resilience/manifest.py",
+)
+MANAGER_FILE = "distributed_resnet_tensorflow_tpu/checkpoint/manager.py"
+MANAGER_WRITER_FN = "_write"
+
+#: call names that perform checkpoint durability I/O
+_IO_NAMES = ("fsync", "fsync_dir", "write_manifest", "staging_path")
+
+
+def _io_call_name(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in _IO_NAMES:
+        return fn.id
+    if isinstance(fn, ast.Attribute) and fn.attr in _IO_NAMES:
+        # os.fsync / manifest.fsync_dir / manifest.write_manifest
+        return fn.attr
+    return None
+
+
+def _function_span(tree: ast.AST, name: str):
+    """(start, end) line range of the named function, or None."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node.lineno, node.end_lineno or node.lineno
+    return None
+
+
+def check(ctx) -> Iterable[Finding]:
+    for sf in ctx.all_python():
+        if sf.tree is None or sf.rel in ALLOWED_FILES:
+            continue
+        writer_span = None
+        if sf.rel == MANAGER_FILE:
+            writer_span = _function_span(sf.tree, MANAGER_WRITER_FN)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _io_call_name(node)
+            if name is None:
+                continue
+            if writer_span is not None and \
+                    writer_span[0] <= node.lineno <= writer_span[1]:
+                continue  # inside the writer entry — the one legal home
+            yield Finding(
+                RULE_NAME, sf.rel, node.lineno,
+                f"checkpoint I/O call {name}() outside the writer path — "
+                "staging/fsync/manifest work belongs in "
+                "CheckpointManager._write (writer thread) or "
+                "resilience/manifest.py; on the train-loop thread it is "
+                "a goodput checkpoint stall the async design exists to "
+                "remove")

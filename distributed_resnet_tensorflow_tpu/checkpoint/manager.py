@@ -65,9 +65,25 @@ def _saveable(state) -> dict:
 
 
 def _host_snapshot(tree):
-    """Blocking device→host copy of every jax.Array leaf, so an async write
-    can proceed while the train loop donates/overwrites the live buffers
-    (same contract orbax's async checkpointing provides)."""
+    """Device→host copy of every jax.Array leaf, so an async write can
+    proceed while the train loop donates/overwrites the live buffers (same
+    contract orbax's async checkpointing provides).
+
+    Two passes: the first ISSUES every copy asynchronously
+    (``copy_to_host_async`` — the transfers land in the runtime's pinned
+    staging buffers and run back-to-back on the D2H stream), the second
+    materializes them. The loop thread therefore pays ONE overlapped
+    transfer of the whole state instead of len(leaves) serial round-trips
+    — the snapshot cost the goodput ``checkpoint`` bucket charges. The
+    wait itself cannot move off this thread: the caller is about to
+    donate these buffers to the next step."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    for x in leaves:
+        if isinstance(x, jax.Array):
+            try:
+                x.copy_to_host_async()
+            except Exception:  # backend without async copies — pass 2 blocks
+                break
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree)
 
@@ -238,13 +254,27 @@ class CheckpointManager:
         checkpoint from an earlier run in the same directory must not
         swallow the current state (the cadence policy lives in
         ``maybe_save``, which never forces)."""
-        # goodput: everything the STEP-LOOP thread pays for this save (the
-        # drain of a previous in-flight save, the host snapshot, and — on
-        # the sync path — the whole write) is checkpoint wall, not compute
-        # (telemetry/goodput.py; the nested wait span charges nothing
-        # extra under the outermost-categorized-span rule)
+        # goodput: ONLY what the STEP-LOOP thread pays for this save is
+        # checkpoint wall — backpressure on a still-in-flight previous
+        # save, the device→host snapshot, and (sync path) the whole write.
+        # The async writer thread's stage/fsync/commit time deliberately
+        # charges NOTHING here: it overlaps compute, and billing it as
+        # checkpoint would claim a stall that never happened. Writer-side
+        # seconds are accounted separately (utils.metrics.ckpt_async_stats
+        # → the {"event": "ckpt_async"} row). The nested spans below charge
+        # nothing extra under the outermost-categorized-span rule.
+        from ..utils.metrics import ckpt_async_stats
         with span("checkpoint.save", category="checkpoint", step=step):
-            self.wait_until_finished()  # serialize with in-flight async save
+            # backpressure: a new save must not overtake an in-flight one
+            # — the writer owns one snapshot at a time, and commit order
+            # must follow step order (wait re-raises a failed write)
+            t0 = time.perf_counter()
+            overtook = self._pending is not None and not self._pending.done()
+            self.wait_until_finished()
+            if overtook:
+                ckpt_async_stats.add(
+                    overtakes=1,
+                    backpressure_seconds=time.perf_counter() - t0)
             if step in self.all_steps() and not force:
                 return  # idempotent: step already checkpointed
             self._check_layout()
@@ -261,13 +291,33 @@ class CheckpointManager:
                     self._write_layout(step)
             tree = _saveable(state)
             if self._async:
-                snapshot = _host_snapshot(tree)
-                self._pending = self._executor.submit(self._write, step,
-                                                      snapshot, force)
+                t1 = time.perf_counter()
+                with span("checkpoint.snapshot", step=step):
+                    snapshot = _host_snapshot(tree)
+                ckpt_async_stats.add(
+                    saves=1, snapshot_seconds=time.perf_counter() - t1)
+                self._pending = self._executor.submit(self._write_async,
+                                                      step, snapshot, force)
             else:
+                ckpt_async_stats.add(saves=1, sync_saves=1)
                 self._write(step, tree, force)
+                ckpt_async_stats.add(committed=1, step=step)
             self._last_save_time = time.monotonic()
             self._last_save_step = step
+
+    def _write_async(self, step: int, tree, force: bool = False) -> None:
+        """The dedicated writer thread's entry: the full stage → fsync →
+        manifest → atomic-rename commit protocol over the host snapshot.
+        Host I/O only — no jax dispatch happens here (pinned by the
+        dispatch-sanitizer test), so it cannot interleave device enqueue
+        order with the train loop. Wall time lands in ckpt_async_stats,
+        NOT the goodput checkpoint bucket (it overlaps compute)."""
+        from ..utils.metrics import ckpt_async_stats
+        t0 = time.perf_counter()
+        with span("checkpoint.writer", step=step):
+            self._write(step, tree, force)
+        ckpt_async_stats.add(committed=1, step=step,
+                             writer_seconds=time.perf_counter() - t0)
 
     def _write(self, step: int, tree, force: bool = False) -> None:
         """Stage → manifest(fsync) → rename(commit) → retention."""
@@ -303,6 +353,11 @@ class CheckpointManager:
             with span("checkpoint.stage", step=step):
                 self._ckptr.save(os.path.join(staging, _PAYLOAD_DIR),
                                  args=ocp.args.StandardSave(tree))
+            # chaos window: env-armed nap between staging and commit (the
+            # kill-during-async-commit test's SIGKILL target); inert in
+            # production (resilience/faultinject.py)
+            from ..resilience.faultinject import maybe_delay_ckpt_commit
+            maybe_delay_ckpt_commit(step)
             if chief:
                 with span("checkpoint.fsync", step=step):
                     write_manifest(staging, step)

@@ -38,9 +38,10 @@ from .resilience.faultinject import maybe_wrap_from_env
 from .resilience.sentinel import train_with_nan_recovery
 from .telemetry import configure_from_config as _configure_telemetry
 from .telemetry.tracer import recorder as _flight_recorder
-from .train.hooks import (CheckpointHook, CorruptRecordsHook, GoodputHook,
-                          HeartbeatHook, InputEchoHook, InputStagesHook,
-                          LoggingHook, NanGuardHook, SummaryHook)
+from .train.hooks import (CheckpointHook, CkptAsyncHook, CommOverlapHook,
+                          CorruptRecordsHook, GoodputHook, HeartbeatHook,
+                          InputEchoHook, InputStagesHook, LoggingHook,
+                          NanGuardHook, SummaryHook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
                            resolve_checkpoint_dir, stacked_layout_stamp)
@@ -399,6 +400,14 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
             hooks.append(GoodputHook(writer,
                                      cfg.telemetry.goodput_every_steps
                                      or cfg.train.summary_every_steps))
+        # async-checkpoint charge split (loop-thread vs writer-thread
+        # seconds) — rows only appear once a save actually ran
+        hooks.append(CkptAsyncHook(writer, cfg.train.summary_every_steps))
+        # bucketed gradient-exchange plan (parallel/overlap.py) — one row
+        # per traced plan; silent when comm.overlap resolved off
+        if trainer.comm_overlap_active:
+            hooks.append(CommOverlapHook(writer,
+                                         cfg.train.summary_every_steps))
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
@@ -637,6 +646,11 @@ def run_train_and_eval(cfg: ExperimentConfig):
                 hooks.append(GoodputHook(
                     writer, cfg.telemetry.goodput_every_steps
                     or cfg.train.summary_every_steps))
+            hooks.append(CkptAsyncHook(writer,
+                                       cfg.train.summary_every_steps))
+            if trainer.comm_overlap_active:
+                hooks.append(CommOverlapHook(
+                    writer, cfg.train.summary_every_steps))
 
     train_iter = _make_train_source(cfg, trainer)
 

@@ -121,10 +121,16 @@ class GroupedBatchNorm(nn.Module):
             xf = xsg.astype(jnp.float32)
             gaxes = tuple(range(1, xsg.ndim - 1))
             gmean = jnp.mean(xf, axis=gaxes)                       # (g, C)
-            gvar = jnp.mean(jnp.square(xf), axis=gaxes) - jnp.square(gmean)
+            gsq = jnp.mean(jnp.square(xf), axis=gaxes)
             if self.axis_name is not None:
+                # pmean the RAW moments (E[x], E[x²]), not the centered
+                # variance: averaging per-shard variances would drop the
+                # between-shard mean spread and understate var — the
+                # shard_map path (parallel/overlap.py) must match the jit
+                # path's global moments
                 gmean = jax.lax.pmean(gmean, self.axis_name)
-                gvar = jax.lax.pmean(gvar, self.axis_name)
+                gsq = jax.lax.pmean(gsq, self.axis_name)
+            gvar = gsq - jnp.square(gmean)
             a, b = affine(gmean, gvar)                             # (g, C)
             bshape = (g,) + (1,) * (xg.ndim - 2) + (features,)
             y = xg * a.reshape(bshape).astype(x.dtype) + \
@@ -136,10 +142,14 @@ class GroupedBatchNorm(nn.Module):
         else:
             xf = xs.astype(jnp.float32)
             mean = jnp.mean(xf, axis=reduce_axes)
-            var = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean)
+            msq = jnp.mean(jnp.square(xf), axis=reduce_axes)
             if self.axis_name is not None:
+                # raw moments, not centered variance — see the grouped
+                # branch above; with axis_name=None the expression below
+                # is bit-identical to the previous var formula
                 mean = jax.lax.pmean(mean, self.axis_name)
-                var = jax.lax.pmean(var, self.axis_name)
+                msq = jax.lax.pmean(msq, self.axis_name)
+            var = msq - jnp.square(mean)
             a, b = affine(mean, var)
             y = x * a.astype(x.dtype) + b.astype(x.dtype)
 

@@ -46,6 +46,8 @@ log = logging.getLogger(__name__)
 NAN_ENV_VAR = "DRT_FAULT_NAN_AT_BATCH"
 FREEZE_ENV_VAR = "DRT_FAULT_FREEZE_AT_BATCH"
 SLOW_ENV_VAR = "DRT_FAULT_SLOW_BATCH_SECS"
+CKPT_COMMIT_SLEEP_ENV_VAR = "DRT_FAULT_CKPT_COMMIT_SLEEP_SECS"
+CKPT_COMMIT_MARKER_ENV_VAR = "DRT_FAULT_CKPT_COMMIT_MARKER"
 
 
 # -- signals ----------------------------------------------------------------
@@ -138,6 +140,39 @@ def corrupt_checkpoint(directory: str, step: Optional[int] = None,
     log.info("fault injection: %s %s (step %d, %d bytes)",
              mode, victim, step, size)
     return step
+
+
+def maybe_delay_ckpt_commit(step: int) -> None:
+    """Env-armed nap in the checkpoint writer BETWEEN staging and the
+    manifest/rename commit (checkpoint/manager._write calls this; inert
+    unless ``DRT_FAULT_CKPT_COMMIT_SLEEP_SECS`` is set) — the
+    kill-during-async-commit window: the staging dir is fully written but
+    UNCOMMITTED, so a SIGKILL here must leave restore on the newest
+    committed step with the torn staging dir swept at the next manager
+    construction (tests/test_checkpoint.py's subprocess case).
+    ``DRT_FAULT_CKPT_COMMIT_MARKER`` names a file appended with the step
+    at nap start, so the killing test knows the writer is in the window
+    (and the charge-split test knows the writer thread, not the loop,
+    paid the nap)."""
+    raw = os.environ.get(CKPT_COMMIT_SLEEP_ENV_VAR, "")
+    if not raw:
+        return
+    try:
+        secs = float(raw)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r",
+                    CKPT_COMMIT_SLEEP_ENV_VAR, raw)
+        return
+    marker = os.environ.get(CKPT_COMMIT_MARKER_ENV_VAR, "")
+    if marker:
+        with open(marker, "a") as f:
+            f.write(f"{step}\n")
+            f.flush()
+            os.fsync(f.fileno())  # shardcheck: ok(ckpt-io-thread)
+    log.warning("fault injection: checkpoint writer napping %.1fs before "
+                "the step-%d commit (%s)", secs, step,
+                CKPT_COMMIT_SLEEP_ENV_VAR)
+    time.sleep(secs)
 
 
 # -- NaN loss ---------------------------------------------------------------

@@ -49,7 +49,8 @@ log = logging.getLogger(__name__)
 
 #: bump when the trace.json event shape changes (consumers key on it via
 #: the ``trace_dump`` metrics row and the file's otherData block)
-SPAN_SCHEMA_VERSION = 2  # 2: + input.echo (data echoing, round 9)
+SPAN_SCHEMA_VERSION = 3  # 3: + checkpoint.snapshot/checkpoint.writer/
+#                              comm.bucket (zero-stall step loop, round 10)
 
 #: every span name the framework emits — register HERE first (the
 #: registry-drift rule rejects unregistered ``span("...")`` literals, the
@@ -72,17 +73,29 @@ SPAN_CATALOG = {
     "eval.round": "one full evaluation round (goodput: eval)",
     "eval.batch": "one eval batch: stage wait + step dispatch",
     # checkpointing (checkpoint/manager.py)
-    "checkpoint.save": "save() on the step-loop thread: host snapshot + "
-                       "handoff (async) or the full write (sync) "
-                       "(goodput: checkpoint)",
+    "checkpoint.save": "save() on the step-loop thread: backpressure + "
+                       "host snapshot + handoff (async) or the full "
+                       "write (sync) (goodput: checkpoint)",
+    "checkpoint.snapshot": "device→host state copy on the step-loop "
+                           "thread (async issue, one overlapped D2H "
+                           "transfer; the loop-blocking leg of an async "
+                           "save)",
     "checkpoint.wait": "step-loop thread blocked on an in-flight async "
                        "save (goodput: checkpoint)",
+    "checkpoint.writer": "the dedicated writer thread's whole "
+                         "stage→fsync→manifest→commit pass over a host "
+                         "snapshot (overlaps compute; accounted in the "
+                         "ckpt_async row, NOT goodput checkpoint)",
     "checkpoint.stage": "orbax serialization into the staging dir "
                         "(writer thread when async)",
     "checkpoint.fsync": "manifest write + fsync",
     "checkpoint.commit": "atomic rename + parent-dir fsync",
     "restore": "checkpoint restore into the live state (goodput: restart "
                "when on the NaN-rollback path)",
+    # gradient-communication overlap (parallel/overlap.py)
+    "comm.bucket": "one planned gradient-exchange bucket (recorded at "
+                   "step TRACE time with bytes/leaves args — the bucket "
+                   "plan, not a per-step event)",
     # serving (serve/server.py, serve/swap.py)
     "serve.batch": "one bucket dispatch: stage + AOT predict + resolve",
     "serve.swap_restore": "off-path host restore of a newer checkpoint",

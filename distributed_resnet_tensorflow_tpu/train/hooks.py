@@ -200,6 +200,63 @@ class GoodputHook(_CadenceHook):
             self.writer.write_event("goodput", {"step": int(step), **itv})
 
 
+class CkptAsyncHook(_CadenceHook):
+    """Export the async-checkpoint charge split (utils.metrics.
+    ckpt_async_stats: loop-thread snapshot/backpressure seconds vs
+    writer-thread stage/fsync/commit seconds) as ``{"event": "ckpt_async"}``
+    rows every N steps WHEN a save advanced since the last export — the
+    row that proves the writer's wall time overlapped compute instead of
+    stalling the loop (only the snapshot + backpressure legs also appear
+    in the goodput ``checkpoint`` bucket). docs/resilience.md has the
+    commit-timeline diagram these numbers annotate."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+        self._exported: Dict[str, Any] = {}
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        from ..utils.metrics import ckpt_async_stats
+        snap = ckpt_async_stats.snapshot()
+        # gate on the WHOLE snapshot changing, not just the save counter:
+        # a row exported while the writer was still mid-commit would
+        # otherwise freeze writer_seconds/committed at ~0 forever —
+        # exactly the final save of every run
+        if snap["saves"] > 0 and snap != self._exported:
+            self._exported = snap
+            self.writer.write_event("ckpt_async",
+                                    {"step": int(step), **snap})
+
+
+class CommOverlapHook(_CadenceHook):
+    """Export the bucketed gradient-exchange plan (parallel/overlap.
+    overlap_stats) as ONE ``{"event": "comm_overlap"}`` row per traced
+    plan — the plan is a property of the compiled step, not of any single
+    step, so re-exporting per cadence would be noise. Writes nothing when
+    the overlap path never traced (comm.overlap resolved off)."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+        self._exported: Dict[str, Any] = {}
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        from ..parallel.overlap import overlap_stats
+        snap = overlap_stats.snapshot()
+        if snap is not None and snap != self._exported:
+            self._exported = snap
+            self.writer.write_event("comm_overlap",
+                                    {"step": int(step), **snap})
+
+
 class CorruptRecordsHook(_CadenceHook):
     """Export the corrupt-TFRecord tally (data/tfrecord.corrupt_records) to
     metrics.jsonl as ``{"event": "corrupt_record"}`` rows — one row per

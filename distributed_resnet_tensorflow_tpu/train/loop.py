@@ -40,21 +40,27 @@ from .schedules import create_schedule
 from .state import TrainState, create_train_state, state_shardings
 
 
-def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
-                       label_smoothing: float = 0.0) -> jax.Array:
-    """Mean softmax CE. Labels are int class ids (the reference one-hotted in
-    the input pipeline, resnet_cifar_main.py:171; we one-hot here once,
-    keeping the input pipeline dense)."""
+def per_example_cross_entropy(logits: jax.Array, labels: jax.Array,
+                              label_smoothing: float = 0.0) -> jax.Array:
+    """Per-example softmax CE (optax path). Labels are int class ids (the
+    reference one-hotted in the input pipeline, resnet_cifar_main.py:171;
+    we one-hot here once, keeping the input pipeline dense)."""
     num_classes = logits.shape[-1]
     onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
     if label_smoothing > 0:
         onehot = onehot * (1 - label_smoothing) + label_smoothing / num_classes
-    logits = logits.astype(jnp.float32)
-    return optax.softmax_cross_entropy(logits, onehot).mean()
+    return optax.softmax_cross_entropy(logits.astype(jnp.float32), onehot)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       label_smoothing: float = 0.0) -> jax.Array:
+    """Mean softmax CE over the batch."""
+    return per_example_cross_entropy(logits, labels, label_smoothing).mean()
 
 
 def make_ce_fn(label_smoothing: float = 0.0, fused_xent: str = "off",
-               mesh: Optional[Mesh] = None) -> Callable:
+               mesh: Optional[Mesh] = None,
+               per_example: bool = False) -> Callable:
     """Resolve ``train.fused_xent`` into the batch CE function.
 
     Modes: "auto" (Pallas kernel iff running on TPU — the default),
@@ -67,30 +73,40 @@ def make_ce_fn(label_smoothing: float = 0.0, fused_xent: str = "off",
     When the mesh splits the batch over >1 shards, the kernel runs under
     ``shard_map`` so each device computes its local (b/n, C) tile — a plain
     ``jit`` would have to replicate the custom call (all-gathering logits).
-    """
+
+    ``per_example=True`` returns the UNREDUCED (b,) CE with the same mode
+    resolution and no shard_map wrap — the inside-shard_map caller
+    (parallel/overlap.make_bucketed_grad) is already per-shard, so the
+    kernel runs directly on the local tile. One resolver for both paths:
+    the overlap loss cannot drift from the jit loss."""
     if fused_xent not in ("auto", "on", "interpret", "off"):
         raise ValueError(f"unknown fused_xent mode {fused_xent!r}")
     mode = fused_xent
     if mode == "auto":
         mode = "on" if jax.default_backend() == "tpu" else "off"
     if mode == "off" or label_smoothing > 0:
-        return lambda logits, labels: cross_entropy_loss(
+        per_ex = lambda logits, labels: per_example_cross_entropy(  # noqa: E731
             logits, labels, label_smoothing)
+        if per_example:
+            return per_ex
+        return lambda logits, labels: per_ex(logits, labels).mean()
     interpret = mode == "interpret"
     from ..ops.pallas import softmax_xent
 
-    def per_example(logits, labels):
+    def per_ex(logits, labels):
         return softmax_xent(logits.astype(jnp.float32), labels, interpret)
 
+    if per_example:
+        return per_ex
     if mesh is not None and batch_shard_count(mesh) > 1:
         batch_axes = present_batch_axes(mesh)
         batch_spec = P(batch_axes)
         sharded = shard_map_compat(
-            per_example, mesh,
+            per_ex, mesh,
             in_specs=(P(batch_axes, None), batch_spec),
             out_specs=batch_spec)
         return lambda logits, labels: sharded(logits, labels).mean()
-    return lambda logits, labels: per_example(logits, labels).mean()
+    return lambda logits, labels: per_ex(logits, labels).mean()
 
 
 def make_train_step(schedule: Callable, weight_decay: float,
@@ -101,15 +117,26 @@ def make_train_step(schedule: Callable, weight_decay: float,
                     ce_fn: Optional[Callable] = None,
                     augment_fn: Optional[Callable] = None,
                     augment_seed: int = 0,
-                    aux_loss_weight: float = 0.01):
+                    aux_loss_weight: float = 0.01,
+                    value_and_grad_fn: Optional[Callable] = None):
     """Build the pure train_step(state, batch) -> (state, metrics).
 
     ``augment_fn(images, rng) -> images`` runs device-side augmentation at
     the top of the step (raw uint8 in, standardized f32 out — see
     ops/augment.py); RNG is fold_in(seed, step): deterministic and
-    resume-stable."""
+    resume-stable.
+
+    ``value_and_grad_fn`` replaces ``jax.value_and_grad(loss_fn)`` with a
+    custom gradient strategy sharing its exact signature/aux contract —
+    the bucketed-overlap exchange (parallel/overlap.make_bucketed_grad)
+    plugs in here. Incompatible with grad_accum_steps > 1 (the
+    accumulation scan exchanges once per accumulated batch)."""
     if ce_fn is None:
         ce_fn = make_ce_fn(label_smoothing)
+    if value_and_grad_fn is not None and grad_accum_steps > 1:
+        raise ValueError(
+            "a custom value_and_grad_fn (comm.overlap) is incompatible "
+            "with train.grad_accum_steps > 1")
 
     def prep(images, step, midx=None):
         if augment_fn is None:
@@ -139,7 +166,8 @@ def make_train_step(schedule: Callable, weight_decay: float,
 
     def single_step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
         images, labels = prep(batch["images"], state.step), batch["labels"]
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        grad_fn = value_and_grad_fn if value_and_grad_fn is not None \
+            else jax.value_and_grad(loss_fn, has_aux=True)
         (loss, (ce, logits, new_bs)), grads = grad_fn(
             state.params, state.batch_stats, images, labels, state.apply_fn)
         new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
@@ -266,6 +294,15 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else create_mesh(cfg.mesh)
         from ..models import create_model
+        # bucketed gradient-communication overlap (parallel/overlap.py):
+        # resolved BEFORE the model build because the shard_map'd step
+        # computes per-shard BN moments — the model must pmean them over
+        # the batch axes (GroupedBatchNorm axis_name) to keep the
+        # cross-replica-BN numerics. comm.overlap=on raises here when the
+        # (model, mesh, train) combination is outside the envelope.
+        from ..parallel.overlap import BATCH_AXES, resolve_overlap
+        self._overlap = resolve_overlap(cfg, self.mesh)
+        bn_axis_name = BATCH_AXES if self._overlap is not None else None
         # cross_replica_bn=True (default): global BN moments — one group.
         # False: reference-faithful per-replica BN — one moment group per
         # batch shard (see ops/batch_norm.py).
@@ -306,6 +343,7 @@ class Trainer:
             # (ring attention inside the stage blocks) — no remaining
             # pairwise rejection on the pipeline axis.
         self.model = create_model(cfg.model, cfg.data.dataset,
+                                  axis_name=bn_axis_name,
                                   remat=cfg.train.remat, bn_groups=bn_groups,
                                   mesh=self.mesh)
         self.schedule = create_schedule(cfg.optimizer)
@@ -444,6 +482,21 @@ class Trainer:
 
     def _build_train_step(self, aug_fn):
         cfg = self.cfg
+        vag = None
+        if self._overlap is not None:
+            # bucketed dp/dp_fsdp gradient exchange replaces the implicit
+            # XLA-propagation all-reduce (parallel/overlap.py): the CE /
+            # decay / aux-loss recipe is mirrored inside the shard_map
+            # body, so the loss semantics are identical to loss_fn's
+            from ..parallel.overlap import make_bucketed_grad
+            vag = make_bucketed_grad(
+                self._overlap, self.mesh,
+                weight_decay=cfg.optimizer.weight_decay,
+                decay_in_loss=not decoupled_decay(cfg.optimizer.name),
+                decay_all_params=cfg.optimizer.decay_all_params,
+                label_smoothing=cfg.optimizer.label_smoothing,
+                fused_xent=cfg.train.fused_xent,
+                aux_loss_weight=cfg.model.moe_aux_weight)
         return make_train_step(
             self.schedule, cfg.optimizer.weight_decay,
             cfg.optimizer.label_smoothing,
@@ -453,7 +506,14 @@ class Trainer:
             ce_fn=make_ce_fn(cfg.optimizer.label_smoothing,
                              cfg.train.fused_xent, self.mesh),
             augment_fn=aug_fn, augment_seed=cfg.train.seed,
-            aux_loss_weight=cfg.model.moe_aux_weight)
+            aux_loss_weight=cfg.model.moe_aux_weight,
+            value_and_grad_fn=vag)
+
+    @property
+    def comm_overlap_active(self) -> bool:
+        """True when the train step exchanges gradients through the
+        bucketed overlap path (parallel/overlap.py)."""
+        return self._overlap is not None
 
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:

@@ -263,6 +263,30 @@ class CheckpointConfig:
 
 
 @dataclass
+class CommConfig:
+    """Gradient-communication overlap (parallel/overlap.py; arXiv:1711.00705
+    bucketed allreduce interleaved with backprop). When enabled, the dp /
+    dp_fsdp gradient exchange is rebuilt as size-bucketed per-bucket psums
+    inside a ``shard_map``-wrapped step so XLA's latency-hiding scheduler
+    can overlap each bucket's collective with the remaining backward pass —
+    numerically identical leaf-by-leaf to the unbucketed exchange (same
+    per-leaf all-reduce over the same operands)."""
+
+    # auto = on iff the run has >1 process (the DCN multi-host dp path the
+    # bucketing exists for) AND the (model, mesh, train) combination
+    # supports it; on = force (raises with the reason when unsupported —
+    # tests and single-host bring-up); off = the default XLA-propagation
+    # exchange
+    overlap: str = "auto"             # auto | on | off
+    # target bucket size: gradient leaves are greedily grouped (in reverse
+    # parameter order, approximating backprop availability — output layers
+    # first) into buckets of at most this many MB; each bucket is one psum
+    # issue. Smaller buckets start communicating earlier but amortize less
+    # per-collective overhead (the DDP knob, arXiv:1711.00705 §4)
+    bucket_mb: float = 4.0
+
+
+@dataclass
 class WatchdogConfig:
     """Distributed health watchdog (resilience/watchdog.py +
     resilience/heartbeat.py): per-process heartbeat daemon + detection of
@@ -432,6 +456,7 @@ class ExperimentConfig:
     data: DataConfig = field(default_factory=DataConfig)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    comm: CommConfig = field(default_factory=CommConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)

@@ -167,6 +167,59 @@ class EchoStats:
 echo_stats = EchoStats()
 
 
+class CkptAsyncStats:
+    """Thread-safe counters splitting checkpoint cost by WHO paid it
+    (checkpoint/manager.py): the step-loop thread's share (device→host
+    snapshot + backpressure waiting on an in-flight save) versus the
+    writer thread's share (stage → fsync → manifest → commit) — the
+    charge-split behind the goodput contract that only loop-blocking time
+    lands in the ``checkpoint`` bucket while writer seconds ride the
+    ``{"event": "ckpt_async"}`` row (train/hooks.CkptAsyncHook)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = dict(saves=0, committed=0, sync_saves=0, overtakes=0,
+                       snapshot_seconds=0.0, backpressure_seconds=0.0,
+                       writer_seconds=0.0)
+        self.last_committed_step = -1
+
+    def add(self, saves: int = 0, committed: int = 0, sync_saves: int = 0,
+            overtakes: int = 0, snapshot_seconds: float = 0.0,
+            backpressure_seconds: float = 0.0,
+            writer_seconds: float = 0.0,
+            step: Optional[int] = None) -> None:
+        with self._lock:
+            self._c["saves"] += saves
+            self._c["committed"] += committed
+            self._c["sync_saves"] += sync_saves
+            self._c["overtakes"] += overtakes
+            self._c["snapshot_seconds"] += snapshot_seconds
+            self._c["backpressure_seconds"] += backpressure_seconds
+            self._c["writer_seconds"] += writer_seconds
+            if step is not None:
+                self.last_committed_step = max(self.last_committed_step,
+                                               int(step))
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in self._c:
+                self._c[k] = 0 if isinstance(self._c[k], int) else 0.0
+            self.last_committed_step = -1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._c)
+            out["last_committed_step"] = self.last_committed_step
+        for k in ("snapshot_seconds", "backpressure_seconds",
+                  "writer_seconds"):
+            out[k] = round(out[k], 4)
+        return out
+
+
+# process-global async-checkpoint accounting (one writer per train run)
+ckpt_async_stats = CkptAsyncStats()
+
+
 #: The metrics.jsonl event registry — the ONE source of truth for every
 #: typed ``{"event": <name>, ...}`` record any part of the framework may
 #: emit. Each entry: {"fields": {field: one-line description},
@@ -209,6 +262,42 @@ EVENT_SCHEMAS = {
             "cache_bytes": "decoded-sample cache size at export",
             "peak_cache_bytes": "high-water cache size (bound witness)",
             "cache_cap_bytes": "configured byte bound",
+        },
+    },
+    "ckpt_async": {
+        "emitted_by": "train/hooks.py CkptAsyncHook (summary cadence, "
+                      "when saves advanced)",
+        "fields": {
+            "step": "step at export time",
+            "saves": "save() calls that snapshotted/wrote (cumulative)",
+            "committed": "commits that completed (manifest + rename)",
+            "sync_saves": "saves that ran the whole write on the loop "
+                          "thread (async off / multi-process)",
+            "overtakes": "saves that found the previous one still in "
+                         "flight (backpressure waits)",
+            "snapshot_seconds": "loop-thread device→host snapshot time "
+                                "(charged to goodput 'checkpoint')",
+            "backpressure_seconds": "loop-thread waits on in-flight "
+                                    "saves (charged to goodput "
+                                    "'checkpoint')",
+            "writer_seconds": "dedicated writer-thread stage/fsync/"
+                              "commit time (overlaps compute; NOT in "
+                              "the goodput checkpoint bucket)",
+            "last_committed_step": "newest step the writer committed",
+        },
+    },
+    "comm_overlap": {
+        "emitted_by": "train/hooks.py CommOverlapHook (once per run, "
+                      "when the bucketed exchange traced)",
+        "fields": {
+            "step": "step at export time",
+            "buckets": "gradient-exchange buckets in the compiled step",
+            "bucket_cap_bytes": "configured comm.bucket_mb in bytes",
+            "bucket_bytes": "per-bucket gradient bytes (reverse "
+                            "parameter order — issue order)",
+            "bucket_leaves": "per-bucket gradient leaf counts",
+            "grad_bytes": "total exchanged gradient bytes per step",
+            "leaves": "gradient leaves exchanged",
         },
     },
     "corrupt_record": {

@@ -63,6 +63,10 @@ memo = functools.lru_cache(maxsize=None)(build)     # line 34: cached-mesh
 def record(span):
     with span("made_up_span"):                      # line 38: registry-drift (span catalog)
         pass
+
+
+def stall_the_loop(f):
+    os.fsync(f.fileno())                            # line 43: ckpt-io-thread
 '''
 
 BAD_SH = '''\
@@ -114,6 +118,8 @@ def test_each_rule_fires_with_file_and_line(bad_repo):
     drift = {(f.path, f.line) for f in by_rule["registry-drift"]}
     assert (bad_py, 27) in drift                       # undeclared event
     assert (bad_py, 38) in drift                       # undeclared span
+    f = by_rule["ckpt-io-thread"][0]
+    assert (f.path, f.line) == (bad_py, 43)
     assert (os.path.join("scripts", "bad.sh"), 2) in drift  # bad --set knob
     assert (os.path.join("scripts", "bad.sh"), 4) in drift  # bad wildcard
     assert (os.path.join("docs", "bad.md"), 2) in drift     # stale doc event
@@ -401,3 +407,46 @@ def test_dispatch_sanitizer_config_knob():
     cfg = parse_args(["--preset", "smoke",
                       "--set", "analysis.dispatch_sanitizer=true"])
     assert cfg.analysis.dispatch_sanitizer is True
+
+
+def test_ckpt_io_rule_scopes_manager_to_writer_fn(tmp_path):
+    """Inside checkpoint/manager.py the durability calls are legal ONLY
+    within _write (the writer-thread entry); the same call in any other
+    method — e.g. a save() that fsyncs on the loop thread — is a
+    finding."""
+    pkg = tmp_path / PKG / "checkpoint"
+    pkg.mkdir(parents=True)
+    (pkg / "manager.py").write_text(
+        "import os\n\n\n"
+        "def _write(step):\n"
+        "    os.fsync(step)        # legal: the writer entry\n\n\n"
+        "def save(step, f):\n"
+        "    os.fsync(f.fileno())  # line 9: loop-thread checkpoint I/O\n")
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    hits = {(f.path, f.line) for f in by_rule.get("ckpt-io-thread", ())}
+    rel = os.path.join(PKG, "checkpoint", "manager.py")
+    assert (rel, 9) in hits
+    assert (rel, 5) not in hits
+
+
+def test_elaborator_traces_bucketed_overlap_step(devices):
+    """The gate traces the comm.overlap=on variant of every in-envelope
+    preset × layout (elab-overlap-step): a clean conv preset elaborates
+    without findings, and the trace actually ran (the plan registry is
+    populated by the shard_map trace)."""
+    from distributed_resnet_tensorflow_tpu.analysis.elaborate import (
+        elaborate_config)
+    from distributed_resnet_tensorflow_tpu.parallel.overlap import (
+        overlap_stats)
+    from distributed_resnet_tensorflow_tpu.utils.config import (
+        MeshConfig, get_preset)
+    cfg = get_preset("cifar10_resnet50")
+    cfg.model.resnet_size = 8
+    cfg.data.image_size = 8
+    cfg.train.batch_size = 16
+    overlap_stats.reset()
+    findings = elaborate_config(cfg, MeshConfig(data=4, fsdp=2),
+                                "fixture@dp_fsdp")
+    assert [f for f in findings if f.rule == "elab-overlap-step"] == [], \
+        [f.message for f in findings]
+    assert overlap_stats.snapshot() is not None

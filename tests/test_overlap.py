@@ -1,0 +1,213 @@
+"""Bucketed gradient-communication overlap (parallel/overlap.py).
+
+The load-bearing claims, each pinned here on the virtual 8-device mesh:
+bucketed and unbucketed (single-bucket) exchanges are BIT-IDENTICAL
+(same per-leaf all-reduce over the same operands — the bucketing
+transformation must be a pure scheduling change), the overlap path
+agrees with the default XLA-propagation step to float rounding across
+dp AND dp_fsdp, the envelope resolver refuses unsupported combinations
+loudly, and the plan telemetry (comm_overlap event) exports what the
+compiled step actually does.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+from distributed_resnet_tensorflow_tpu.parallel.overlap import (
+    overlap_stats, overlap_unsupported_reason, plan_buckets,
+    resolve_overlap)
+from distributed_resnet_tensorflow_tpu.train import Trainer
+from distributed_resnet_tensorflow_tpu.utils.config import (MeshConfig,
+                                                            get_preset)
+
+
+def _tiny_cfg(**kw):
+    cfg = get_preset("smoke")
+    cfg.model.compute_dtype = "float32"
+    cfg.model.resnet_size = 8
+    cfg.model.num_classes = 4
+    cfg.data.image_size = 8
+    cfg.train.batch_size = 16
+    cfg.optimizer.schedule = "constant"
+    cfg.checkpoint.save_every_secs = 0.0
+    for k, v in kw.items():
+        cfg.override(k, v)
+    return cfg
+
+
+def _fixed_batches(n=4, bs=16, size=8, classes=4):
+    rng = np.random.RandomState(7)
+    imgs = rng.randn(n, bs, size, size, 3).astype(np.float32)
+    labs = rng.randint(0, classes, (n, bs)).astype(np.int32)
+    return [{"images": imgs[i], "labels": labs[i]} for i in range(n)]
+
+
+def _flat_params(state):
+    return np.concatenate([np.asarray(l).ravel() for l in
+                           jax.tree_util.tree_leaves(state.params)])
+
+
+def _train(mesh_cfg, batches, **kw):
+    cfg = _tiny_cfg(**kw)
+    tr = Trainer(cfg, mesh=create_mesh(mesh_cfg))
+    tr.init_state()
+    state, metrics = tr.train(iter(list(batches)), num_steps=len(batches))
+    return _flat_params(state), metrics
+
+
+# ---------------------------------------------------------------------------
+# exactness (the acceptance claim)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(data=8),                    # dp
+    MeshConfig(data=4, fsdp=2),            # dp_fsdp (gather + reduce-scatter)
+], ids=["dp", "dp_fsdp"])
+def test_bucketed_is_bit_identical_to_unbucketed(mesh_cfg):
+    """Many tiny buckets vs one bucket holding everything: the per-leaf
+    psum operands are identical either way, so the trained params must be
+    BITWISE equal — bucketing may only change collective scheduling,
+    never numerics."""
+    batches = _fixed_batches()
+    many, m1 = _train(mesh_cfg, batches,
+                      **{"comm.overlap": "on", "comm.bucket_mb": "0.05"})
+    plan = overlap_stats.snapshot()
+    assert plan is not None and plan["buckets"] > 1, plan
+    one, m2 = _train(mesh_cfg, batches,
+                     **{"comm.overlap": "on", "comm.bucket_mb": "4096"})
+    assert overlap_stats.snapshot()["buckets"] == 1
+    np.testing.assert_array_equal(many, one)
+    assert float(m1["loss"]) == float(m2["loss"])
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(data=8),
+    MeshConfig(data=4, fsdp=2),
+], ids=["dp", "dp_fsdp"])
+def test_overlap_matches_default_path_to_float_rounding(mesh_cfg):
+    """Against the default XLA-propagation exchange the reduction TREE
+    differs (local-sum-then-psum vs XLA's schedule), so agreement is to
+    float rounding, not bitwise — a few steps of a float32 model stay
+    within a tight allclose."""
+    batches = _fixed_batches()
+    base, mb = _train(mesh_cfg, batches, **{"comm.overlap": "off"})
+    over, mo = _train(mesh_cfg, batches, **{"comm.overlap": "on",
+                                            "comm.bucket_mb": "0.1"})
+    np.testing.assert_allclose(over, base, rtol=2e-4, atol=2e-5)
+    assert abs(float(mo["loss"]) - float(mb["loss"])) < 1e-4
+
+
+def test_overlap_composes_with_fused_multi_step(devices):
+    """steps_per_loop > 1 wraps the shard_map'd step in lax.scan — the
+    fused dispatch must produce the same params as the unfused loop."""
+    batches = _fixed_batches(n=4)
+    stacked_equal, _ = _train(MeshConfig(data=8), batches,
+                              **{"comm.overlap": "on",
+                                 "comm.bucket_mb": "0.05",
+                                 "train.steps_per_loop": "2"})
+    unfused, _ = _train(MeshConfig(data=8), batches,
+                        **{"comm.overlap": "on", "comm.bucket_mb": "0.05"})
+    np.testing.assert_allclose(stacked_equal, unfused, rtol=1e-5,
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bucket planning
+# ---------------------------------------------------------------------------
+
+def test_plan_buckets_reverse_order_and_cap():
+    # leaves of 3,3,3,3 bytes with a 6-byte cap: reverse-order pairs
+    assert plan_buckets([3, 3, 3, 3], 6) == [[3, 2], [1, 0]]
+    # an oversized leaf gets its own bucket, never split
+    assert plan_buckets([100, 1, 1], 8) == [[2, 1], [0]]
+    # everything fits: one bucket, still reverse order
+    assert plan_buckets([1, 2, 3], 100) == [[2, 1, 0]]
+    assert plan_buckets([], 8) == []
+
+
+# ---------------------------------------------------------------------------
+# envelope / resolver
+# ---------------------------------------------------------------------------
+
+def test_resolver_gates(devices):
+    mesh = create_mesh(MeshConfig(data=8))
+    # off → None regardless of support
+    assert resolve_overlap(_tiny_cfg(**{"comm.overlap": "off"}), mesh) is None
+    # auto on a single-process run stays off (the DCN path is the target)
+    assert resolve_overlap(_tiny_cfg(), mesh) is None
+    # on → forced
+    plan = resolve_overlap(_tiny_cfg(**{"comm.overlap": "on"}), mesh)
+    assert plan is not None and plan.bucket_bytes == 4 * 2 ** 20
+
+    # unsupported combinations raise WITH the reason under "on"
+    for kw, needle in [
+        ({"train.grad_accum_steps": "2"}, "grad_accum"),
+        ({"model.cross_replica_bn": "false"}, "cross_replica_bn"),
+        ({"train.batch_size": "12"}, "does not divide"),
+    ]:
+        bad = _tiny_cfg(**{"comm.overlap": "on", **kw})
+        assert overlap_unsupported_reason(bad, mesh) is not None
+        with pytest.raises(ValueError, match=needle):
+            resolve_overlap(bad, mesh)
+        # ...and quietly resolve off under "auto"
+        bad.comm.overlap = "auto"
+        assert resolve_overlap(bad, mesh) is None
+
+    vit = _tiny_cfg(**{"comm.overlap": "on"})
+    vit.model.name = "vit"
+    with pytest.raises(ValueError, match="transformer"):
+        Trainer(vit, mesh=mesh)
+
+    # a single-shard mesh is what checkpoint consumers (evaluator, a
+    # 1-device serving replica) see — a forced train-only knob must
+    # resolve off there, loudly, not crash the consumer
+    single = create_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    assert resolve_overlap(_tiny_cfg(**{"comm.overlap": "on"}),
+                           single) is None
+
+
+def test_per_replica_bn_envelope_exceptions(devices):
+    """norm='group' has no batch coupling, so per-replica-BN gating must
+    not block it; frozen BN likewise."""
+    mesh = create_mesh(MeshConfig(data=8))
+    for norm in ("group", "frozen"):
+        cfg = _tiny_cfg(**{"comm.overlap": "on",
+                           "model.cross_replica_bn": "false"})
+        cfg.model.norm = norm
+        assert overlap_unsupported_reason(cfg, mesh) is None
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def test_comm_overlap_event_row(tmp_path, devices):
+    from distributed_resnet_tensorflow_tpu.train.hooks import CommOverlapHook
+    from distributed_resnet_tensorflow_tpu.utils.metrics import (
+        MetricsWriter, read_metrics)
+    overlap_stats.reset()
+    batches = _fixed_batches(n=2)
+    cfg = _tiny_cfg(**{"comm.overlap": "on", "comm.bucket_mb": "0.05"})
+    tr = Trainer(cfg, mesh=create_mesh(MeshConfig(data=8)))
+    assert tr.comm_overlap_active
+    tr.init_state()
+    w = MetricsWriter(str(tmp_path), enable_tensorboard=False)
+    hook = CommOverlapHook(w, every_steps=1)
+    tr.train(iter(batches), num_steps=2, hooks=(hook,))
+    w.close()
+    rows = [r for r in read_metrics(str(tmp_path))
+            if r.get("event") == "comm_overlap"]
+    assert len(rows) == 1  # one row per traced plan, not per step
+    row = rows[0]
+    assert row["buckets"] > 1
+    assert sum(row["bucket_bytes"]) == row["grad_bytes"]
+    assert sum(row["bucket_leaves"]) == row["leaves"]
+
+
+def test_overlap_off_writes_no_plan(devices):
+    overlap_stats.reset()
+    batches = _fixed_batches(n=1)
+    _train(MeshConfig(data=8), batches, **{"comm.overlap": "off"})
+    assert overlap_stats.snapshot() is None
