@@ -852,6 +852,115 @@ def _overlap_ab(n_steps: int = 20):
     return rows
 
 
+def bench_zero1(budget_left):
+    """The ZeRO-1 sharded-weight-update row (ISSUE 11; arXiv:2004.13336):
+    per-replica optimizer-state bytes + steps/s for dp vs dp+ZeRO-1 (and
+    the comm.overlap composition) on a multi-device mesh, plus the
+    reduce-scatter / all-gather payload accounting from the bucket plan.
+    Runs in-process when this backend has >1 device, else in a subprocess
+    with 8 virtual CPU devices (the --overlap-ab pattern: structure check
+    + honest CPU numbers; the memory win is layout-true everywhere, the
+    step-time story needs a real mesh)."""
+    if budget_left() < 60:
+        return {"skipped": "over bench budget"}
+    try:
+        if len(jax.devices()) > 1:
+            return _zero1_ab()
+        import subprocess
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--zero1-ab"],
+            capture_output=True, text=True, env=env,
+            timeout=max(60, budget_left()))
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-300:])
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        out["virtual_devices"] = 8
+        return out
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _zero1_ab(n_steps: int = 20):
+    """optimizer.zero1 off / on / on+overlap step time AND per-replica
+    optimizer-state bytes on THIS backend's devices. The byte numbers
+    are measured from the LIVE state's shardings (per-device shard
+    shapes), not projected — the (N-1)/N shrink for shardable leaves is
+    the acceptance claim. LAMB (mu+nu — double moments) makes the memory
+    story visible at rn8 scale."""
+    from distributed_resnet_tensorflow_tpu.parallel.overlap import (
+        overlap_stats)
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import (
+        shard_batch, zero1_stats)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    rng = np.random.RandomState(0)
+    bs = 64
+    images = rng.randn(bs, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, (bs,)).astype(np.int32)
+
+    def opt_bytes_per_replica(state):
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(state.opt_state):
+            if not hasattr(leaf, "sharding"):
+                continue
+            shard_shape = leaf.sharding.shard_shape(leaf.shape)
+            total += int(np.prod(shard_shape, dtype=np.int64)) * \
+                leaf.dtype.itemsize
+        return total
+
+    rows = {}
+    for label, zero1, overlap in (("off", "off", "off"),
+                                  ("zero1", "on", "off"),
+                                  ("zero1_overlap", "on", "on")):
+        cfg = get_preset("cifar10_resnet50")
+        cfg.model.resnet_size = 8
+        cfg.train.batch_size = bs
+        cfg.optimizer.name = "lamb"
+        cfg.optimizer.weight_decay = 1e-4
+        cfg.optimizer.zero1 = zero1
+        cfg.optimizer.zero1_min_size = 256
+        cfg.comm.overlap = overlap
+        cfg.comm.bucket_mb = 0.25
+        cfg.mesh.data = len(jax.devices())
+        zero1_stats.reset()
+        overlap_stats.reset()
+        trainer = Trainer(cfg)
+        trainer.init_state()
+        step_fn = trainer.jitted_train_step()
+        batch = shard_batch({"images": images, "labels": labels},
+                            trainer.mesh)
+        state = trainer.state
+        for _ in range(3):  # compile + warm
+            state, _m = step_fn(state, batch)
+        jax.block_until_ready(state.params)
+        per_replica = opt_bytes_per_replica(state)
+        state, dt = _best_time(step_fn, state, [batch], n_steps, reps=3)
+        rows[label] = {"steps_per_sec": round(n_steps / dt, 2),
+                       "step_ms": round(dt / n_steps * 1000, 2),
+                       "opt_bytes_per_replica": per_replica}
+        if zero1 == "on":
+            rows[label]["plan"] = zero1_stats.snapshot()
+        if overlap == "on":
+            rows[label]["comm_plan"] = overlap_stats.snapshot()
+    rows["opt_bytes_ratio_off_over_zero1"] = round(
+        rows["off"]["opt_bytes_per_replica"] /
+        max(rows["zero1"]["opt_bytes_per_replica"], 1), 2)
+    plan = rows["zero1"].get("plan") or {}
+    if plan.get("sharded_bytes"):
+        # the acceptance claim: shardable leaves shrink by (N-1)/N
+        n = plan.get("data_shards", 1)
+        rows["shardable_bytes_per_replica"] = plan["sharded_bytes"] // n
+        rows["shardable_reduction"] = round(
+            1 - (plan["sharded_bytes"] // n) / plan["sharded_bytes"], 4)
+        rows["expected_reduction"] = round((n - 1) / n, 4)
+    return rows
+
+
 def bench_serving(budget_left):
     """The serving row (serve/; docs/serving.md): open-loop synthetic load
     against the AOT-compiled batched inference server — p50/p99 request
@@ -955,6 +1064,10 @@ def main():
         # via env XLA_FLAGS; single JSON line on stdout)
         print(json.dumps(_overlap_ab()))
         return
+    if "--zero1-ab" in sys.argv:
+        # bench_zero1's multi-device re-entry (same contract)
+        print(json.dumps(_zero1_ab()))
+        return
     t0 = time.monotonic()
     try:
         budget = float(os.environ.get("BENCH_BUDGET_SECS", "900"))
@@ -995,6 +1108,10 @@ def main():
                     # zero-stall step loop (ROADMAP item 5): async-vs-sync
                     # checkpoint stall + the bucketed-exchange A/B
                     ("overlap", lambda: bench_overlap(budget_left)),
+                    # ZeRO-1 sharded weight update (ISSUE 11): per-replica
+                    # optimizer bytes + steps/s, dp vs dp+ZeRO-1, with the
+                    # reduce-scatter/all-gather payload plan
+                    ("zero1", lambda: bench_zero1(budget_left)),
                     ("imagenet_norm_contracts",
                      lambda: bench_imagenet_norm(budget_left))):
         if time.monotonic() - t0 > budget:

@@ -33,21 +33,33 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
                        help="skip the linter")
     p.add_argument("--devices", type=int, default=8,
                    help="virtual CPU mesh size for elaboration (default 8)")
+    p.add_argument("--no-zero1-sweep", action="store_true",
+                   help="skip the 64/256-device ZeRO-1 big-mesh sweep "
+                        "(elab-zero1)")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="print finding detail (full tracebacks)")
     ns = p.parse_args(argv)
 
     findings = []
     t0 = time.perf_counter()
+    if not ns.lint_only:
+        # the virtual mesh must exist BEFORE the first jax backend use —
+        # and the LINT pass is now a backend user too (unsharded-opt-state
+        # resolves preset states via eval_shape), so the flags go down
+        # before anything else runs. Sized for the big-mesh ZeRO-1 sweep
+        # when it runs (virtual CPU devices are threads over one host
+        # platform; 256 of them cost ~nothing at eval_shape-only load).
+        from ..utils.virtual_devices import apply_virtual_cpu
+        from .elaborate import ZERO1_SWEEP_SIZES
+        n_virtual = ns.devices if ns.no_zero1_sweep \
+            else max(ns.devices, max(ZERO1_SWEEP_SIZES))
+        apply_virtual_cpu(n_virtual)
     if not ns.elaborate_only:
         from .lint import run_lint
         findings += run_lint()
         print(f"lint: {len(findings)} finding(s) "
               f"[{time.perf_counter() - t0:.1f}s]")
     if not ns.lint_only:
-        # the virtual mesh must exist BEFORE the first jax backend use
-        from ..utils.virtual_devices import apply_virtual_cpu
-        apply_virtual_cpu(ns.devices)
         from .elaborate import run_elaborate
         t1 = time.perf_counter()
         presets = ns.preset or None  # None = all
@@ -55,6 +67,13 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
         print(f"elaborate: {len(efs)} finding(s) "
               f"[{time.perf_counter() - t1:.1f}s]")
         findings += efs
+        if not ns.no_zero1_sweep:
+            from .elaborate import run_elaborate_zero1
+            t2 = time.perf_counter()
+            zfs = run_elaborate_zero1(presets)
+            print(f"elab-zero1 (64/256-device sweep): {len(zfs)} "
+                  f"finding(s) [{time.perf_counter() - t2:.1f}s]")
+            findings += zfs
 
     from .report import format_findings
     print(format_findings(findings, verbose=ns.verbose))

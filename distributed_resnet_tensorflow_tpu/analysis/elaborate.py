@@ -150,6 +150,7 @@ def check_spec_tree(state_shapes, shardings, mesh,
 
 def elaborate_config(cfg, mesh_cfg, locus: str,
                      trace_steps: bool = True,
+                     trace_forward: bool = True,
                      _state_cache: Optional[dict] = None) -> List[Finding]:
     """Elaborate ONE (config, mesh layout): returns findings (empty=clean).
 
@@ -160,7 +161,15 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
     would buy nothing. Transformer configs re-trace per layout (the mesh
     is baked into the pipeline/tensor/expert program). ``_state_cache``
     memoizes the abstract state per batch-shard count for the same
-    reason."""
+    reason.
+
+    ``trace_forward=False`` additionally skips the OPTIMIZER-INDEPENDENT
+    traces (eval step, serve buckets) — used when another preset with
+    the identical forward config (model × data × serve) already traced
+    them: the large-batch optimizer variants (lars4k/lamb4k/lars32k)
+    share imagenet_resnet50's forward exactly, and re-sweeping every
+    serve bucket per optimizer would triple the gate's largest cost for
+    zero coverage."""
     import jax
     from ..parallel.mesh import batch_shard_count, create_mesh
     from ..train.loop import Trainer
@@ -220,14 +229,17 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
                                                "train step", e))
 
         # eval step: batch padded exactly as Trainer.evaluate pads it
-        # (batch shards × pipeline microbatches)
+        # (batch shards × pipeline microbatches). Optimizer-independent:
+        # skipped when an identical-forward preset already traced it
+        # (trace_forward)
         try:
-            pad_to = trainer.eval_pad_multiple()
-            ebs = cfg.data.eval_batch_size
-            ebs = ebs + (-ebs) % pad_to  # pad_batch_to_multiple contract
-            ebatch = _abstract_batch(cfg, ebs)
-            ebatch["mask"] = jax.ShapeDtypeStruct((ebs,), np.float32)
-            jax.eval_shape(trainer._eval_step, state_shapes, ebatch)
+            if trace_forward:
+                pad_to = trainer.eval_pad_multiple()
+                ebs = cfg.data.eval_batch_size
+                ebs = ebs + (-ebs) % pad_to  # pad_batch_to_multiple contract
+                ebatch = _abstract_batch(cfg, ebs)
+                ebatch["mask"] = jax.ShapeDtypeStruct((ebs,), np.float32)
+                jax.eval_shape(trainer._eval_step, state_shapes, ebatch)
         except Exception as e:
             findings.append(_findings_from_exc("elab-eval-step", locus,
                                                "eval step", e))
@@ -237,17 +249,21 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
         # power-of-two buckets in multiples of the eval pad floor, the
         # request dtype from serve_image_spec), traced abstractly so a
         # bucket that can't trace is a gate finding here, not a serving
-        # replica that dies warming its compile cache
+        # replica that dies warming its compile cache. Optimizer-
+        # independent like the eval step (trace_forward).
+        buckets = []
         try:
-            from ..serve.compile_cache import bucket_sizes
-            from ..serve.server import serve_image_spec
-            pad_to = trainer.eval_pad_multiple()
-            img_shape, img_dtype = serve_image_spec(cfg)
-            # the SAME cap resolution the server uses (InferenceServer):
-            # a preset pinning serve.max_batch past eval_batch_size gets
-            # its real buckets elaborated, not the eval-sized ones
-            max_batch = cfg.serve.max_batch or cfg.data.eval_batch_size
-            buckets = bucket_sizes(max_batch, pad_to)
+            if trace_forward:
+                from ..serve.compile_cache import bucket_sizes
+                from ..serve.server import serve_image_spec
+                pad_to = trainer.eval_pad_multiple()
+                img_shape, img_dtype = serve_image_spec(cfg)
+                # the SAME cap resolution the server uses
+                # (InferenceServer): a preset pinning serve.max_batch
+                # past eval_batch_size gets its real buckets elaborated,
+                # not the eval-sized ones
+                max_batch = cfg.serve.max_batch or cfg.data.eval_batch_size
+                buckets = bucket_sizes(max_batch, pad_to)
         except Exception as e:
             findings.append(_findings_from_exc("elab-serve-step", locus,
                                                "serve step setup", e))
@@ -349,6 +365,137 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
     return findings
 
 
+#: virtual mesh sizes the ZeRO-1 big-mesh sweep validates against —
+#: catching a spec that only breaks at scale (a moment dim 64 devices
+#: divide but 256 don't) STATICALLY, before any cluster time
+ZERO1_SWEEP_SIZES = (64, 256)
+
+
+def run_elaborate_zero1(preset_names: Optional[Sequence[str]] = None,
+                        sizes: Sequence[int] = ZERO1_SWEEP_SIZES
+                        ) -> List[Finding]:
+    """The ``elab-zero1`` big-mesh sweep: for every in-envelope preset —
+    one that enables ``optimizer.zero1`` (on/auto; a preset with the
+    knob off has no ZeRO-1 step or sharded specs to elaborate) and whose
+    global batch the layout divides — resolve the ZeRO-1 sharded
+    optimizer-state specs on virtual 64- and 256-device dp and dp_fsdp
+    meshes and spec-check every leaf (``check_spec_tree`` — the
+    offending leaf PATH, not a step-1 ``_SpecError`` on a real pod); for
+    presets that PIN the knob on, additionally ``eval_shape`` the full
+    ZeRO-1 train step (reduce-scatter constraint + sharded update +
+    gather) on the largest mesh. Zero compute; rides the same gate
+    budget contract as the 8-device sweep (scripts/analysis_gate.sh)."""
+    import copy
+    import jax
+    from ..parallel.mesh import create_mesh
+    from ..parallel.sharding import (ZERO1_MIN_SIZE, Zero1Report,
+                                     zero1_state_shardings,
+                                     zero1_unsupported_reason)
+    from ..train.loop import Trainer
+    from ..train.state import abstract_train_state
+    from ..utils.config import MeshConfig, PRESETS, get_preset
+
+    import dataclasses
+    findings: List[Finding] = []
+    need = max(sizes)
+    if len(jax.devices()) < need:
+        return [Finding(
+            "elab-env", "zero1-sweep", 0,
+            f"{len(jax.devices())} devices present, {need} needed — the "
+            "check CLI must size the virtual CPU mesh for the ZeRO-1 "
+            "sweep before jax initializes")]
+    # abstract states shared across presets with the identical
+    # (model, optimizer) pair — the large-batch variants of one base
+    # preset differ only in schedule hyperparams, not state SHAPES
+    shared_states: dict = {}
+    for name in (preset_names or sorted(PRESETS)):
+        cfg = get_preset(name)
+        if cfg.optimizer.zero1 == "off":
+            continue  # no ZeRO-1 step/specs to elaborate for this preset
+        state_key = repr((dataclasses.asdict(cfg.model),
+                          cfg.optimizer.name, cfg.data.dataset,
+                          cfg.data.image_size))
+        state_shapes = shared_states.get(state_key)
+        traced = False
+        for n in sorted(sizes, reverse=True):
+            if cfg.train.batch_size % n:
+                continue  # the layout cannot host this preset's batch
+            layouts = [(f"zero1-dp{n}", MeshConfig(data=n)),
+                       (f"zero1-dp{n // 2}f2",
+                        MeshConfig(data=n // 2, fsdp=2))]
+            for label, mesh_cfg in layouts:
+                locus = f"{name}@{label}"
+                try:
+                    mesh = create_mesh(mesh_cfg, devices=jax.devices()[:n])
+                except Exception as e:
+                    findings.append(_findings_from_exc(
+                        "elab-zero1", locus, "mesh build", e))
+                    continue
+                if zero1_unsupported_reason(cfg, mesh) is not None:
+                    continue  # outside the envelope — documented, not a bug
+                try:
+                    if state_shapes is None:
+                        # model/optimizer shapes are mesh-independent for
+                        # the batch-parallel families: build once per
+                        # (model, optimizer), spec-check every
+                        # (preset, size, layout)
+                        t = Trainer(copy.deepcopy(cfg), mesh=mesh)
+                        state_shapes = abstract_train_state(
+                            t.model, t.tx,
+                            (1, cfg.data.image_size,
+                             cfg.data.image_size, 3)
+                            if cfg.model.name != "logistic"
+                            else (1, cfg.model.input_size))
+                        shared_states[state_key] = state_shapes
+                except Exception as e:
+                    findings.append(_findings_from_exc(
+                        "elab-zero1", locus, "state init", e))
+                    break
+                try:
+                    min_size = cfg.optimizer.zero1_min_size \
+                        or ZERO1_MIN_SIZE
+                    report = Zero1Report(mesh.shape.get("data", 1))
+                    opt_sh = zero1_state_shardings(
+                        state_shapes.opt_state, mesh, min_size=min_size,
+                        report=report)
+                    findings.extend(check_spec_tree(
+                        state_shapes.opt_state, opt_sh, mesh, locus))
+                    if cfg.optimizer.zero1 == "on" and \
+                            report.sharded_leaves == 0:
+                        findings.append(Finding(
+                            "elab-zero1", locus, 0,
+                            "optimizer.zero1=on resolves FULLY replicated "
+                            f"at {n} data shards "
+                            f"(reasons: {report.reasons}) — the promised "
+                            "per-replica memory cut vanishes at this "
+                            "scale"))
+                except Exception as e:
+                    findings.append(_findings_from_exc(
+                        "elab-zero1", locus, "zero1 sharding rules", e))
+                    continue
+                # trace the full ZeRO-1 step once per preset that PINS
+                # the knob on, on the largest dp layout — the reduce-
+                # scatter constraint / sharded update / gather must
+                # TRACE at scale, not just spec-check ("auto" presets
+                # spec-check only: their step is covered by the 8-device
+                # sweep and the "on" presets' traces)
+                if cfg.optimizer.zero1 == "on" and not traced \
+                        and mesh_cfg.fsdp <= 1:
+                    traced = True
+                    try:
+                        ocfg = copy.deepcopy(cfg)
+                        ocfg.optimizer.zero1 = "on"
+                        otrainer = Trainer(ocfg, mesh=mesh)
+                        batch = _abstract_batch(ocfg,
+                                                ocfg.train.batch_size)
+                        jax.eval_shape(otrainer._train_step,
+                                       state_shapes, batch)
+                    except Exception as e:
+                        findings.append(_findings_from_exc(
+                            "elab-zero1", locus, "zero1 train step", e))
+    return findings
+
+
 def run_elaborate(preset_names: Optional[Sequence[str]] = None,
                   n_devices: int = 8) -> List[Finding]:
     """Elaborate the named presets (default: all) across their candidate
@@ -364,10 +511,20 @@ def run_elaborate(preset_names: Optional[Sequence[str]] = None,
             f"{len(jax.devices())} devices present, {n_devices} needed — "
             "the check CLI must set up the virtual CPU mesh before jax "
             "initializes")]
+    import dataclasses
+    seen_forward: set = set()
     for name in (preset_names or sorted(PRESETS)):
         cfg = get_preset(name)
         state_cache: dict = {}
         traced = False
+        # optimizer-independent traces (eval step, serve buckets) dedupe
+        # across presets sharing the identical forward config — the
+        # large-batch optimizer variants of one base preset
+        fwd_key = repr((dataclasses.asdict(cfg.model),
+                        dataclasses.asdict(cfg.data),
+                        dataclasses.asdict(cfg.serve)))
+        fwd = fwd_key not in seen_forward
+        seen_forward.add(fwd_key)
         for label, mesh_cfg in candidate_layouts(cfg, n_devices):
             # the step graph only changes with PROGRAM-SHAPING axes
             # (pipeline/tensor/expert/seq bake shard_maps into the model);
@@ -381,6 +538,7 @@ def run_elaborate(preset_names: Optional[Sequence[str]] = None,
             findings.extend(
                 elaborate_config(cfg, mesh_cfg, f"{name}@{label}",
                                  trace_steps=trace,
+                                 trace_forward=trace and fwd,
                                  _state_cache=state_cache))
             traced = True
     return findings

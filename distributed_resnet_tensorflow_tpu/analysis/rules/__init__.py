@@ -8,7 +8,7 @@ known-bad fixture to tests/test_analysis.py and a row to the catalog in
 docs/static_analysis.md.
 """
 from . import (bare_assert, cached_mesh, ckpt_io, device_put, exit_codes,
-               registry_drift)
+               opt_state, registry_drift)
 
 ALL_RULES = (
     device_put,
@@ -17,4 +17,5 @@ ALL_RULES = (
     exit_codes,
     registry_drift,
     ckpt_io,
+    opt_state,
 )

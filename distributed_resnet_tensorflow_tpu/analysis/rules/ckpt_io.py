@@ -31,9 +31,13 @@ DOC = __doc__
 
 ALLOWED_FILES = (
     "distributed_resnet_tensorflow_tpu/resilience/manifest.py",
+    # the per-host sharded payload writer (round 11): all of its
+    # fsync/staging work runs on the writer thread by construction —
+    # CheckpointManager._write_sharded is its only production caller
+    "distributed_resnet_tensorflow_tpu/checkpoint/shards.py",
 )
 MANAGER_FILE = "distributed_resnet_tensorflow_tpu/checkpoint/manager.py"
-MANAGER_WRITER_FN = "_write"
+MANAGER_WRITER_FNS = ("_write", "_write_sharded")
 
 #: call names that perform checkpoint durability I/O
 _IO_NAMES = ("fsync", "fsync_dir", "write_manifest", "staging_path")
@@ -62,23 +66,24 @@ def check(ctx) -> Iterable[Finding]:
     for sf in ctx.all_python():
         if sf.tree is None or sf.rel in ALLOWED_FILES:
             continue
-        writer_span = None
+        writer_spans = []
         if sf.rel == MANAGER_FILE:
-            writer_span = _function_span(sf.tree, MANAGER_WRITER_FN)
+            writer_spans = [s for s in (_function_span(sf.tree, fn)
+                                        for fn in MANAGER_WRITER_FNS)
+                            if s is not None]
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue
             name = _io_call_name(node)
             if name is None:
                 continue
-            if writer_span is not None and \
-                    writer_span[0] <= node.lineno <= writer_span[1]:
-                continue  # inside the writer entry — the one legal home
+            if any(lo <= node.lineno <= hi for lo, hi in writer_spans):
+                continue  # inside a writer entry — the legal homes
             yield Finding(
                 RULE_NAME, sf.rel, node.lineno,
                 f"checkpoint I/O call {name}() outside the writer path — "
                 "staging/fsync/manifest work belongs in "
-                "CheckpointManager._write (writer thread) or "
-                "resilience/manifest.py; on the train-loop thread it is "
-                "a goodput checkpoint stall the async design exists to "
-                "remove")
+                "CheckpointManager._write/_write_sharded (writer thread), "
+                "checkpoint/shards.py, or resilience/manifest.py; on the "
+                "train-loop thread it is a goodput checkpoint stall the "
+                "async design exists to remove")

@@ -102,7 +102,9 @@ class CheckpointManager:
                  layout_stamp: Optional[dict] = None,
                  verify_on_restore: bool = True,
                  io_retries: int = 3,
-                 writer: bool = True):
+                 writer: bool = True,
+                 sharded: str = "auto",
+                 finalize_timeout_secs: float = 300.0):
         # layout_stamp: declares how depth-stacked params are ORDERED (the
         # circular pipeline schedule stores stage-major order, a function of
         # (pstages, interleave) — models/pipeline.py). Saved as a sidecar so
@@ -123,11 +125,27 @@ class CheckpointManager:
         # background writer — async happens on OUR worker thread, over a
         # host snapshot, with the whole stage→manifest→rename sequence
         self._ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+        # per-host SHARDED payloads (checkpoint/shards.py): each host's
+        # writer stages only the pieces its devices own; the multi-process
+        # finalize coordinates over marker files — no collectives on the
+        # writer thread, which is what makes multi-process saves ASYNC-
+        # capable at all. auto = on iff the run has peers; the
+        # single-payload orbax layout stays the single-process default
+        # (and both layouts restore from either writer).
+        if sharded not in ("auto", "on", "off"):
+            raise ValueError(f"unknown checkpoint.sharded setting "
+                             f"{sharded!r}")
+        self._sharded = sharded == "on" or (
+            sharded == "auto" and jax.process_count() > 1)
+        self.finalize_timeout_secs = finalize_timeout_secs
         # async: host-snapshot on the caller thread (correct wrt donated
         # buffers), serialize+commit on one background worker. Multi-process
-        # saves are synchronous: every process must participate in the
-        # sharded write, and a per-process thread would skew the barrier.
-        self._async = async_save and jax.process_count() == 1
+        # saves may only run async on the SHARDED layout (the orbax path
+        # barriers its collective write internally — a per-process thread
+        # would skew that barrier; the sharded writer coordinates over
+        # files instead).
+        self._async = async_save and (jax.process_count() == 1
+                                      or self._sharded)
         self._executor = (ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="drt-ckpt")
             if self._async else None)
@@ -290,7 +308,40 @@ class CheckpointManager:
                                              step) > step):
                     self._write_layout(step)
             tree = _saveable(state)
-            if self._async:
+            if self._sharded:
+                from . import shards as shards_mod
+                t1 = time.perf_counter()
+                with span("checkpoint.snapshot", step=step):
+                    parts = shards_mod.host_snapshot_parts(tree)
+                ckpt_async_stats.add(
+                    saves=1, snapshot_seconds=time.perf_counter() - t1)
+                if jax.process_count() > 1:
+                    # pre-handoff coordination ON THE LOOP THREAD (the
+                    # only thread collectives may run on): the chief
+                    # clears stale staging from a crashed earlier
+                    # attempt, then the SNAPSHOT BARRIER guarantees
+                    # every host snapshotted THIS step and sees the
+                    # cleaned staging before any writer touches it. The
+                    # writer threads coordinate over marker files only.
+                    if jax.process_index() == 0:
+                        # deliberate loop-thread exception: this cleanup
+                        # must finish before the barrier releases peers'
+                        # writers (a writer-thread rmtree could eat a
+                        # peer's freshly staged shard)
+                        staging = staging_path(self.directory, step)  # shardcheck: ok(ckpt-io-thread)
+                        if os.path.isdir(staging):
+                            shutil.rmtree(staging)
+                    from jax.experimental import multihost_utils
+                    multihost_utils.sync_global_devices(
+                        f"drt_ckpt_snapshot_{step}")
+                if self._async:
+                    self._pending = self._executor.submit(
+                        self._write_sharded_async, step, parts, force)
+                else:
+                    ckpt_async_stats.add(sync_saves=1)
+                    self._write_sharded(step, parts, force)
+                    ckpt_async_stats.add(committed=1, step=step)
+            elif self._async:
                 t1 = time.perf_counter()
                 with span("checkpoint.snapshot", step=step):
                     snapshot = _host_snapshot(tree)
@@ -318,6 +369,128 @@ class CheckpointManager:
             self._write(step, tree, force)
         ckpt_async_stats.add(committed=1, step=step,
                              writer_seconds=time.perf_counter() - t0)
+
+    def _write_sharded_async(self, step: int, parts,
+                             force: bool = False) -> None:
+        """Writer-thread entry for the SHARDED layout: host I/O + marker-
+        file coordination only — no jax dispatch, no collectives (the
+        property that lets multi-process saves run async at all)."""
+        from ..utils.metrics import ckpt_async_stats
+        t0 = time.perf_counter()
+        with span("checkpoint.writer", step=step):
+            self._write_sharded(step, parts, force)
+        ckpt_async_stats.add(committed=1, step=step,
+                             writer_seconds=time.perf_counter() - t0)
+
+    def _write_sharded(self, step: int, parts, force: bool = False) -> None:
+        """Per-host sharded stage → marker → (chief) finalize
+        (checkpoint/shards.py): every process writes only the array
+        pieces its devices own plus a durable ``.done`` marker; the chief
+        waits for all markers, then runs the usual manifest + atomic
+        commit rename. Peers wait for the chief's rename to become
+        visible so ``wait_until_finished`` (and the preemption final
+        save) keeps its "committed when it returns" meaning on every
+        host. docs/resilience.md has the timeline."""
+        from . import shards as shards_mod
+        from ..utils.metrics import ckpt_async_stats
+        staging = staging_path(self.directory, step)
+        final = os.path.join(self.directory, str(step))
+        pidx = jax.process_index()
+        chief = pidx == 0
+        multi = jax.process_count() > 1
+
+        def stage_and_commit():
+            if os.path.isdir(final) and not force:
+                return  # committed on an earlier attempt: done
+            # fresh staging per single-process attempt; the multi-process
+            # cleanup happened on the loop thread BEFORE the snapshot
+            # barrier (save()) — a writer-thread rmtree here could eat a
+            # peer's freshly staged shard
+            if chief and not multi and os.path.isdir(staging):
+                shutil.rmtree(staging)
+            t0 = time.perf_counter()
+            with span("checkpoint.shard", step=step):
+                nbytes, nfiles = shards_mod.write_host_shards(
+                    staging, pidx, parts)
+                shards_mod.write_done_marker(staging, pidx)
+                fsync_dir(os.path.join(staging, shards_mod.SHARDS_DIR))
+            ckpt_async_stats.add(shard_bytes=nbytes, shard_files=nfiles,
+                                 shard_seconds=time.perf_counter() - t0)
+            deadline = time.monotonic() + self.finalize_timeout_secs
+            if chief:
+                with span("checkpoint.finalize", step=step):
+                    t1 = time.perf_counter()
+                    need = set(range(jax.process_count()))
+                    while not need <= shards_mod.done_markers(staging):
+                        if time.monotonic() > deadline:
+                            missing = sorted(
+                                need - shards_mod.done_markers(staging))
+                            raise TimeoutError(
+                                f"sharded save step {step}: hosts "
+                                f"{missing} never staged their shards "
+                                f"within {self.finalize_timeout_secs}s")
+                        time.sleep(0.05)
+                    ckpt_async_stats.add(
+                        finalize_wait_seconds=time.perf_counter() - t1)
+                    # chaos window: env-armed nap between staging and
+                    # commit — the kill-during-sharded-commit test's
+                    # SIGKILL target (resilience/faultinject.py)
+                    from ..resilience.faultinject import \
+                        maybe_delay_ckpt_commit
+                    maybe_delay_ckpt_commit(step)
+                    if os.path.isdir(final):
+                        # forced overwrite (see _write): move the stale
+                        # same-numbered dir aside before the rename
+                        aside = final + ".replaced"
+                        shutil.rmtree(aside, ignore_errors=True)
+                        os.replace(final, aside)
+                        shutil.rmtree(aside, ignore_errors=True)
+                    with span("checkpoint.fsync", step=step):
+                        write_manifest(staging, step)
+                    with span("checkpoint.commit", step=step):
+                        os.replace(staging, final)
+                        fsync_dir(self.directory)
+            else:
+                # peers block until the chief's commit rename lands (the
+                # staging dir vanishes atomically with it): a process
+                # must not report its save finished — or exit, for the
+                # final preemption save — before the step is committed
+                with span("checkpoint.finalize", step=step):
+                    t1 = time.perf_counter()
+                    while os.path.isdir(staging):
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"sharded save step {step}: the chief "
+                                "never committed within "
+                                f"{self.finalize_timeout_secs}s")
+                        time.sleep(0.05)
+                    ckpt_async_stats.add(
+                        finalize_wait_seconds=time.perf_counter() - t1)
+                    if not os.path.isdir(final):
+                        raise RuntimeError(
+                            f"sharded save step {step}: staging vanished "
+                            "without a committed step — the chief's "
+                            "writer failed")
+
+        error: Optional[BaseException] = None
+        try:
+            # single-process attempts retry like _write (idempotent:
+            # staging rebuilt from the in-memory parts); multi-process
+            # does one attempt — a re-staging host would race the
+            # chief's marker wait
+            retry_call(stage_and_commit,
+                       retries=self.io_retries if not multi else 0,
+                       retry_on=(OSError,),
+                       description=f"sharded checkpoint write "
+                                   f"(step {step})")
+        except BaseException as e:
+            error = e
+            if chief and not multi:
+                shutil.rmtree(staging, ignore_errors=True)
+        if error is not None:
+            raise error
+        if chief:
+            self._apply_retention()
 
     def _write(self, step: int, tree, force: bool = False) -> None:
         """Stage → manifest(fsync) → rename(commit) → retention."""
@@ -537,9 +710,18 @@ class CheckpointManager:
                 self._quarantine(s)
                 continue
             try:
-                restored = self._ckptr.restore(
-                    self._payload_path(s),
-                    args=ocp.args.StandardRestore(abstract))
+                from . import shards as shards_mod
+                step_dir = os.path.join(self.directory, str(s))
+                if shards_mod.is_sharded_layout(step_dir):
+                    # per-host sharded layout: reassemble each leaf from
+                    # every host's pieces and re-shard into the LIVE
+                    # state's rule-table layout — works across a
+                    # different writer host count by construction
+                    restored = self._restore_sharded(step_dir, abstract)
+                else:
+                    restored = self._ckptr.restore(
+                        self._payload_path(s),
+                        args=ocp.args.StandardRestore(abstract))
             except Exception as e:
                 if explicit:
                     raise CheckpointCorrupt(
@@ -573,6 +755,45 @@ class CheckpointManager:
             f"every committed checkpoint in {self.directory} failed to "
             f"restore: {failures} — refusing to silently restart from "
             "scratch; move or delete the directory to start over")
+
+    def _restore_sharded(self, step_dir: str, abstract):
+        """Restore one committed SHARDED checkpoint into the structure/
+        shardings of ``abstract``: merge every host index, reassemble
+        each leaf from its byte-range pieces (cross-host-count safe),
+        validate shape+dtype against the live state, and place per the
+        target sharding — the re-shard path that lets a 2-host save
+        restore at 1 host and vice versa. Any inconsistency raises; the
+        caller's fallback ladder then tries the next older checkpoint."""
+        from . import shards as shards_mod
+        flat, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+        out = []
+        with shards_mod.ShardReader(step_dir) as reader:
+            keys = reader.keys()
+            for path, leaf in flat:
+                key = shards_mod.leaf_key(path)
+                if key not in keys:
+                    raise ValueError(
+                        f"sharded checkpoint is missing state leaf {key}")
+                arr = reader.assemble(key)
+                shape = tuple(getattr(leaf, "shape", ()))
+                if tuple(np.shape(arr)) != shape:
+                    raise ValueError(
+                        f"leaf {key}: checkpoint shape "
+                        f"{tuple(np.shape(arr))} != state shape {shape}")
+                dtype = getattr(leaf, "dtype", None)
+                if dtype is not None and np.dtype(arr.dtype) != \
+                        np.dtype(dtype):
+                    raise ValueError(
+                        f"leaf {key}: checkpoint dtype {arr.dtype} != "
+                        f"state dtype {dtype}")
+                sharding = getattr(leaf, "sharding", None)
+                if sharding is not None:
+                    np_arr = np.asarray(arr)
+                    arr = jax.make_array_from_callback(
+                        shape, sharding,
+                        lambda idx, a=np_arr: a[idx])
+                out.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     # -- teardown ----------------------------------------------------------
     def wait_until_finished(self) -> None:

@@ -1,0 +1,360 @@
+"""Per-host sharded checkpoint payloads — stage only what you own.
+
+The single-payload (orbax) layout makes every save a whole-state write and
+forces multi-process saves to be SYNCHRONOUS (orbax barriers its sharded
+write with collectives, which may not run on a writer thread). With the
+ZeRO-1 optimizer sharding (parallel/sharding.py) the state is no longer
+even fully addressable per host — so checkpointing follows the layout:
+
+  * every host's writer thread serializes ONLY the array pieces its own
+    devices own (the ZeRO-1 optimizer shard, fsdp param shards) into
+    ``shards/host-<p>.bin`` + a JSON index, fsyncs them, and drops a
+    ``.done-<p>`` marker;
+  * the chief additionally writes the replicated leaves once
+    (``shards/base.bin``) and finalizes by WAITING ON MARKER FILES — no
+    collectives off the main thread — before the usual manifest + atomic
+    commit rename (resilience/manifest.py);
+  * restore merges every index in the committed dir and reassembles each
+    leaf from byte-range pieces, so the reader needs no knowledge of the
+    writer's host count: save at N processes, restore at M, re-sharding
+    into whatever layout the live state's rule table resolved.
+
+The piece format is deliberately dumb: raw ``tobytes()`` payloads at
+recorded offsets with dtype/shape/start in the index (bfloat16 round-trips
+via ml_dtypes' registered numpy dtype). Integrity is the manifest's job —
+every file here lands in MANIFEST.json's size+SHA-256 list like any other
+payload file.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+SHARDS_DIR = "shards"
+SHARD_FORMAT = 1
+_DONE_PREFIX = ".done-"
+
+
+def leaf_key(path) -> str:
+    """Canonical flat key of one state leaf (jax keystr) — the join key
+    between a live state's flattened tree and the shard indexes."""
+    return jax.tree_util.keystr(path)
+
+
+def _path_components(path) -> List[dict]:
+    """JSON-able path encoding, enough to rebuild DICT subtrees (the
+    serving hot-swap reads params/batch_stats this way); NamedTuple /
+    sequence components are recorded but only dicts are rebuildable."""
+    out = []
+    for p in path:
+        if hasattr(p, "key"):
+            out.append({"k": str(p.key)})
+        elif hasattr(p, "idx"):
+            out.append({"i": int(p.idx)})
+        elif hasattr(p, "name"):
+            out.append({"a": str(p.name)})
+        else:
+            out.append({"r": str(p)})
+    return out
+
+
+def _piece_start(index, shape) -> Tuple[int, ...]:
+    """Normalized start offsets of one shard index (tuple of slices)."""
+    return tuple(int(s.start or 0) for s in index) if index else ()
+
+
+def _owned_pieces(arr: jax.Array) -> List[Tuple[Tuple[int, ...], Any]]:
+    """[(start_offsets, device_data)] for the array pieces THIS process
+    owns. Ownership of a (possibly replicated) piece goes to the lowest
+    device id holding it, so the union across processes covers the array
+    exactly once — no host writes bytes another host already owns."""
+    by_idx: Dict[Tuple, Any] = {}
+    owner: Dict[Tuple, Any] = {}
+    for shard in arr.addressable_shards:
+        key = _piece_start(shard.index, arr.shape)
+        if key not in by_idx:
+            by_idx[key] = shard
+    for dev, index in arr.sharding.devices_indices_map(arr.shape).items():
+        key = _piece_start(index, arr.shape)
+        cur = owner.get(key)
+        if cur is None or dev.id < cur.id:
+            owner[key] = dev
+    pidx = jax.process_index()
+    return [(key, shard.data) for key, shard in sorted(by_idx.items())
+            if owner[key].process_index == pidx]
+
+
+class SnapshotParts:
+    """One host's view of a state snapshot, split by destination file:
+    ``base`` (replicated leaves, chief-written) and ``owned`` (this
+    host's pieces of sharded leaves). All payloads are host numpy by the
+    time the writer thread sees this — the loop thread materializes."""
+
+    __slots__ = ("base", "owned")
+
+    def __init__(self, base, owned):
+        self.base = base      # [(key, path_components, np.ndarray)]
+        self.owned = owned    # [(key, path_components, global_shape,
+        #                        dtype_str, [(start, np.ndarray)])]
+
+
+def host_snapshot_parts(tree) -> SnapshotParts:
+    """Device→host snapshot of ``tree`` for the sharded layout. Like
+    ``manager._host_snapshot`` the D2H copies are ISSUED asynchronously
+    first (one overlapped transfer) and then materialized — the
+    loop-thread cost an async save pays. Must run on the loop thread:
+    the caller is about to donate these buffers to the next step.
+
+    Only the CHIEF collects the replicated (``base``) leaves — they are
+    chief-written (``write_host_shards``), and a peer snapshotting the
+    full replicated params tree per save would charge real D2H wall to
+    the goodput ``checkpoint`` bucket for bytes it immediately drops."""
+    chief = jax.process_index() == 0
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    plan = []
+    for path, leaf in flat:
+        if isinstance(leaf, jax.Array) and not leaf.sharding.is_fully_replicated:
+            plan.append((path, leaf, _owned_pieces(leaf)))
+        elif chief:
+            plan.append((path, leaf, None))
+    # pass 1: issue every copy
+    for _path, leaf, pieces in plan:
+        targets = [p for _s, p in pieces] if pieces is not None else \
+            ([leaf] if isinstance(leaf, jax.Array) else [])
+        for t in targets:
+            try:
+                t.copy_to_host_async()
+            except Exception:
+                break
+    # pass 2: materialize
+    base, owned = [], []
+    for path, leaf, pieces in plan:
+        key = leaf_key(path)
+        comps = _path_components(path)
+        if pieces is None:
+            base.append((key, comps, np.asarray(leaf)))
+        else:
+            owned.append((key, comps, tuple(int(d) for d in leaf.shape),
+                          str(np.dtype(leaf.dtype)),
+                          [(start, np.asarray(data))
+                           for start, data in pieces]))
+    return SnapshotParts(base, owned)
+
+
+def _write_pieces(shards_dir: str, stem: str, leaves) -> Tuple[int, int]:
+    """Write one ``<stem>.bin`` + ``<stem>.json`` pair; ``leaves`` is
+    [(key, comps, global_shape, dtype, [(start, np.ndarray)])]. Returns
+    (payload_bytes, files_written). Both files are fsynced — durability
+    before the marker/manifest says so."""
+    os.makedirs(shards_dir, exist_ok=True)
+    bin_path = os.path.join(shards_dir, stem + ".bin")
+    index: List[dict] = []
+    nbytes = 0
+    with open(bin_path, "wb") as f:
+        for key, comps, gshape, dtype, pieces in leaves:
+            rec = {"key": key, "path": comps, "shape": list(gshape),
+                   "dtype": dtype, "pieces": []}
+            for start, arr in pieces:
+                arr = np.ascontiguousarray(arr)
+                off = f.tell()
+                data = arr.tobytes()
+                f.write(data)
+                rec["pieces"].append({
+                    "offset": off, "nbytes": len(data),
+                    "start": list(start), "shape": list(arr.shape)})
+                nbytes += len(data)
+            index.append(rec)
+        f.flush()
+        os.fsync(f.fileno())
+    json_path = os.path.join(shards_dir, stem + ".json")
+    with open(json_path, "w") as f:
+        json.dump({"format": SHARD_FORMAT,
+                   "process_count": jax.process_count(),
+                   "leaves": index}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    return nbytes, 2
+
+
+def write_host_shards(staging_dir: str, process_index: int,
+                      parts: SnapshotParts) -> Tuple[int, int]:
+    """This host's contribution: its owned pieces of every sharded leaf
+    (``host-<p>``) and, on the chief, the replicated base leaves
+    (``base``). Returns (payload_bytes, files)."""
+    shards_dir = os.path.join(staging_dir, SHARDS_DIR)
+    total_b = total_f = 0
+    if parts.owned:
+        b, n = _write_pieces(shards_dir, f"host-{process_index:05d}",
+                             parts.owned)
+        total_b += b
+        total_f += n
+    if process_index == 0:
+        b, n = _write_pieces(shards_dir, "base", [
+            (key, comps, tuple(arr.shape), str(np.dtype(arr.dtype)),
+             [((0,) * arr.ndim, arr)])
+            for key, comps, arr in parts.base])
+        total_b += b
+        total_f += n
+    return total_b, total_f
+
+
+def write_done_marker(staging_dir: str, process_index: int) -> None:
+    """Durable witness that this host's shard files are fully staged —
+    the ONLY coordination primitive of the multi-process finalize (plain
+    files on the shared directory; no collectives off the main thread)."""
+    shards_dir = os.path.join(staging_dir, SHARDS_DIR)
+    os.makedirs(shards_dir, exist_ok=True)
+    path = os.path.join(shards_dir, f"{_DONE_PREFIX}{process_index:05d}")
+    with open(path, "w") as f:
+        f.write("ok")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def done_markers(staging_dir: str) -> set:
+    """Process indices whose done markers are visible."""
+    shards_dir = os.path.join(staging_dir, SHARDS_DIR)
+    out = set()
+    try:
+        names = os.listdir(shards_dir)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith(_DONE_PREFIX):
+            try:
+                out.add(int(name[len(_DONE_PREFIX):]))
+            except ValueError:
+                continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# read side
+# ---------------------------------------------------------------------------
+
+def is_sharded_layout(step_dir: str) -> bool:
+    """True when ``step_dir`` holds a per-host sharded payload."""
+    shards_dir = os.path.join(step_dir, SHARDS_DIR)
+    try:
+        return any(n.endswith(".json") for n in os.listdir(shards_dir))
+    except OSError:
+        return False
+
+
+class ShardReader:
+    """Merged view of every index file in one committed step dir; leaves
+    assemble from byte-range pieces regardless of how many hosts wrote
+    them — the cross-host-count restore path."""
+
+    def __init__(self, step_dir: str):
+        self.shards_dir = os.path.join(step_dir, SHARDS_DIR)
+        self._leaves: Dict[str, dict] = {}
+        self._handles: Dict[str, Any] = {}
+        for name in sorted(os.listdir(self.shards_dir)):
+            if not name.endswith(".json"):
+                continue
+            with open(os.path.join(self.shards_dir, name)) as f:
+                idx = json.load(f)
+            stem = name[:-len(".json")]
+            for rec in idx.get("leaves", []):
+                cur = self._leaves.setdefault(rec["key"], {
+                    "shape": tuple(rec["shape"]),
+                    "dtype": rec["dtype"],
+                    "path": rec.get("path", []),
+                    "pieces": []})
+                if cur["shape"] != tuple(rec["shape"]) or \
+                        cur["dtype"] != rec["dtype"]:
+                    raise ValueError(
+                        f"shard indexes disagree about leaf {rec['key']!r}")
+                for piece in rec["pieces"]:
+                    cur["pieces"].append((stem, piece))
+
+    def close(self) -> None:
+        for f in self._handles.values():
+            f.close()
+        self._handles.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def keys(self) -> set:
+        return set(self._leaves)
+
+    def _read(self, stem: str, offset: int, nbytes: int) -> bytes:
+        f = self._handles.get(stem)
+        if f is None:
+            f = self._handles[stem] = open(
+                os.path.join(self.shards_dir, stem + ".bin"), "rb")
+        f.seek(offset)
+        data = f.read(nbytes)
+        if len(data) != nbytes:
+            raise ValueError(
+                f"short read in {stem}.bin ({len(data)}/{nbytes} bytes)")
+        return data
+
+    def assemble(self, key: str) -> np.ndarray:
+        """Reassemble one leaf from every piece any host wrote. Raises if
+        the pieces do not cover the full array — a torn or mixed-step
+        shard set must fail the restore (the caller falls back to an
+        older committed checkpoint), never silently zero-fill."""
+        meta = self._leaves[key]
+        shape, dtype = meta["shape"], np.dtype(meta["dtype"])
+        if shape == ():
+            stem, piece = meta["pieces"][0]
+            return np.frombuffer(
+                self._read(stem, piece["offset"], piece["nbytes"]),
+                dtype=dtype).reshape(())[()]
+        out = np.empty(shape, dtype)
+        covered = 0
+        seen = set()
+        for stem, piece in meta["pieces"]:
+            start = tuple(piece["start"])
+            pshape = tuple(piece["shape"])
+            if (start, pshape) in seen:
+                continue  # duplicated piece (replicated writers)
+            seen.add((start, pshape))
+            arr = np.frombuffer(
+                self._read(stem, piece["offset"], piece["nbytes"]),
+                dtype=dtype).reshape(pshape)
+            sel = tuple(slice(s, s + d) for s, d in zip(start, pshape))
+            out[sel] = arr
+            covered += arr.size
+        if covered < int(np.prod(shape, dtype=np.int64)):
+            raise ValueError(
+                f"leaf {key!r} pieces cover {covered} of "
+                f"{int(np.prod(shape))} elements — torn shard set")
+        return out
+
+    def read_subtree(self, root: str) -> Any:
+        """Rebuild the nested-DICT subtree rooted at top-level key
+        ``root`` (e.g. "params", "batch_stats") as host numpy — the
+        serving hot-swap's restore path (serve/swap.py). Only dict path
+        components exist under those roots by construction."""
+        out: dict = {}
+        found = False
+        for key, meta in self._leaves.items():
+            comps = meta["path"]
+            if not comps or comps[0] != {"k": root}:
+                continue
+            found = True
+            cur = out
+            for c in comps[1:-1]:
+                if "k" not in c:
+                    raise ValueError(
+                        f"non-dict path component {c} under {root!r}")
+            for c in comps[1:-1]:
+                cur = cur.setdefault(c["k"], {})
+            if len(comps) == 1:
+                return self.assemble(key)
+            cur[comps[-1]["k"]] = self.assemble(key)
+        if not found and root not in ("batch_stats",):
+            raise KeyError(f"no leaves under {root!r} in shard indexes")
+        return out

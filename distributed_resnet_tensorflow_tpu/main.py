@@ -38,10 +38,11 @@ from .resilience.faultinject import maybe_wrap_from_env
 from .resilience.sentinel import train_with_nan_recovery
 from .telemetry import configure_from_config as _configure_telemetry
 from .telemetry.tracer import recorder as _flight_recorder
-from .train.hooks import (CheckpointHook, CkptAsyncHook, CommOverlapHook,
-                          CorruptRecordsHook, GoodputHook, HeartbeatHook,
-                          InputEchoHook, InputStagesHook, LoggingHook,
-                          NanGuardHook, SummaryHook)
+from .train.hooks import (CheckpointHook, CkptAsyncHook, CkptShardHook,
+                          CommOverlapHook, CorruptRecordsHook, GoodputHook,
+                          HeartbeatHook, InputEchoHook, InputStagesHook,
+                          LoggingHook, NanGuardHook, SummaryHook,
+                          Zero1Hook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
                            resolve_checkpoint_dir, stacked_layout_stamp)
@@ -348,7 +349,9 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         async_save=cfg.checkpoint.async_save,
         layout_stamp=stacked_layout_stamp(cfg),
         verify_on_restore=res.verify_on_restore,
-        io_retries=res.io_retries)
+        io_retries=res.io_retries,
+        sharded=cfg.checkpoint.sharded,
+        finalize_timeout_secs=cfg.checkpoint.finalize_timeout_secs)
 
     start_step = 0
     if cfg.checkpoint.resume:
@@ -408,6 +411,23 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         if trainer.comm_overlap_active:
             hooks.append(CommOverlapHook(writer,
                                          cfg.train.summary_every_steps))
+        # ZeRO-1 partition plan (parallel/sharding.py rule table) — one
+        # row per resolved plan; silent when optimizer.zero1 resolved off
+        if trainer.zero1_active:
+            hooks.append(Zero1Hook(writer, cfg.train.summary_every_steps))
+    # per-host sharded-checkpoint accounting: EVERY process exports its
+    # own ckpt_shard rows (each host stages only its shard — the chief's
+    # stream alone would claim 1/N of the cluster's bytes). Non-chief
+    # processes get a tiny dedicated event stream (train-p<idx>) the
+    # monitor's rollup sums across hosts.
+    shard_writer = None
+    if cfg.checkpoint.sharded != "off":
+        shard_writer = writer
+        if shard_writer is None:
+            shard_writer = _make_writer(
+                cfg, f"train-p{jax.process_index()}")
+        hooks.append(CkptShardHook(shard_writer,
+                                   cfg.train.summary_every_steps))
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
@@ -490,6 +510,8 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         if listener is not None:
             listener.uninstall()
         manager.close()
+        if shard_writer is not None and shard_writer is not writer:
+            shard_writer.close()  # the non-chief ckpt_shard stream
         if writer is not None:
             # tensorboardX buffers events (~2 min flush window): without
             # the close, the tail of a completed run's summaries is lost
@@ -615,7 +637,9 @@ def run_train_and_eval(cfg: ExperimentConfig):
         async_save=cfg.checkpoint.async_save,
         layout_stamp=stacked_layout_stamp(cfg),
         verify_on_restore=cfg.resilience.verify_on_restore,
-        io_retries=cfg.resilience.io_retries)
+        io_retries=cfg.resilience.io_retries,
+        sharded=cfg.checkpoint.sharded,
+        finalize_timeout_secs=cfg.checkpoint.finalize_timeout_secs)
     if cfg.checkpoint.resume:
         trainer.state, _ = manager.restore(trainer.state)
 
@@ -651,6 +675,19 @@ def run_train_and_eval(cfg: ExperimentConfig):
             if trainer.comm_overlap_active:
                 hooks.append(CommOverlapHook(
                     writer, cfg.train.summary_every_steps))
+            if trainer.zero1_active:
+                hooks.append(Zero1Hook(writer,
+                                       cfg.train.summary_every_steps))
+    # per-host sharded-ckpt accounting: every process exports, like
+    # run_train (the monitor's per-host rollup reads these)
+    te_shard_writer = None
+    if cfg.checkpoint.sharded != "off":
+        te_shard_writer = writer
+        if te_shard_writer is None:
+            te_shard_writer = _make_writer(
+                cfg, f"train-p{jax.process_index()}")
+        hooks.append(CkptShardHook(te_shard_writer,
+                                   cfg.train.summary_every_steps))
 
     train_iter = _make_train_source(cfg, trainer)
 
@@ -714,6 +751,8 @@ def run_train_and_eval(cfg: ExperimentConfig):
         if listener is not None:
             listener.uninstall()
         manager.close()
+        if te_shard_writer is not None and te_shard_writer is not writer:
+            te_shard_writer.close()  # the non-chief ckpt_shard stream
         if writer:
             # flush buffered tensorboardX events even on a mid-run error
             writer.close()

@@ -195,11 +195,16 @@ def plan_buckets(leaf_bytes: Sequence[int],
 
 def _fsdp_dim(spec: P) -> Optional[int]:
     """The dimension a PartitionSpec shards over ``fsdp``, or None."""
+    return _axis_dim(spec, "fsdp")
+
+
+def _axis_dim(spec: P, axis: str) -> Optional[int]:
+    """The dimension a PartitionSpec shards over ``axis``, or None."""
     for d, names in enumerate(spec):
         if names is None:
             continue
         names = names if isinstance(names, tuple) else (names,)
-        if "fsdp" in names:
+        if axis in names:
             return d
     return None
 
@@ -215,13 +220,23 @@ def _param_specs(params: Any, mesh: Mesh):
                                   is_leaf=lambda x: hasattr(x, "spec"))
 
 
-def _exchange_bucket(leaves, specs):
+def _exchange_bucket(leaves, specs, out_specs=None):
     """One bucket's gradient exchange: replicated leaves ride a single
     tuple-psum over both batch axes (one collective issue); fsdp-sharded
     leaves psum over ``data`` and psum_scatter over ``fsdp`` on their
     sharded dim (the ZeRO reduce-scatter), landing exactly in the leaf's
-    training-state layout. Returns leaves in input order."""
-    rep_idx = [i for i, s in enumerate(specs) if _fsdp_dim(s) is None]
+    training-state layout. Returns leaves in input order.
+
+    ``out_specs`` (the ZeRO-1 path, arXiv:2004.13336) additionally names
+    a ``data`` dim per leaf: those leaves reduce-SCATTER over ``data``
+    instead of psumming, so each replica receives only its optimizer
+    shard's gradient slice — 1/N the data-axis payload, landing exactly
+    in the sharded weight-update layout."""
+    if out_specs is None:
+        out_specs = specs
+    z1_dims = [_axis_dim(o, "data") for o in out_specs]
+    rep_idx = [i for i, s in enumerate(specs)
+               if _fsdp_dim(s) is None and z1_dims[i] is None]
     out: List[Any] = [None] * len(leaves)
     if rep_idx:
         summed = lax.psum(tuple(leaves[i] for i in rep_idx), BATCH_AXES)
@@ -229,15 +244,24 @@ def _exchange_bucket(leaves, specs):
             out[i] = v
     for i, (leaf, spec) in enumerate(zip(leaves, specs)):
         d = _fsdp_dim(spec)
-        if d is None:
+        dz = z1_dims[i]
+        if d is None and dz is None:
             continue
-        # reduce-scatter FIRST: the data-axis psum then carries the
-        # 1/fsdp-sized shard instead of the full leaf — same sum (the
-        # axes reduce independently), fsdp× less payload on the
-        # inter-host axis this path exists to relieve
-        shard = lax.psum_scatter(leaf, "fsdp", scatter_dimension=d,
-                                 tiled=True)
-        out[i] = lax.psum(shard, "data")
+        # reduce-scatter FIRST on every sharded axis: the remaining
+        # collective then carries the scattered shard instead of the full
+        # leaf — same sum (the axes reduce independently), N× less
+        # payload on the axis this path exists to relieve
+        if d is not None:
+            leaf = lax.psum_scatter(leaf, "fsdp", scatter_dimension=d,
+                                    tiled=True)
+        if dz is not None:
+            leaf = lax.psum_scatter(leaf, "data", scatter_dimension=dz,
+                                    tiled=True)
+            if d is None:
+                leaf = lax.psum(leaf, "fsdp")
+        else:
+            leaf = lax.psum(leaf, "data")
+        out[i] = leaf
     return out
 
 
@@ -247,7 +271,8 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                        decay_all_params: bool = False,
                        label_smoothing: float = 0.0,
                        fused_xent: str = "off",
-                       aux_loss_weight: float = 0.01) -> Callable:
+                       aux_loss_weight: float = 0.01,
+                       zero1_min_size: Optional[int] = None) -> Callable:
     """Drop-in replacement for ``jax.value_and_grad(loss_fn, has_aux=True)``
     in train/loop.make_train_step's single step:
 
@@ -259,7 +284,15 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
     semantics to the jit path); logits reassemble into the global array;
     new_batch_stats is replicated by construction (the model's BN pmean's
     its moments over the batch axes — Trainer builds the model with
-    ``axis_name=BATCH_AXES`` when overlap is active)."""
+    ``axis_name=BATCH_AXES`` when overlap is active).
+
+    ``zero1_min_size`` (non-None = ZeRO-1 active, the value is the
+    replication floor in elements) switches the exchange to the ZeRO-1
+    form (``parallel.sharding.zero1_grad_specs``): leaves the rule table
+    assigns a ``data`` dim reduce-SCATTER over ``data`` and come out in
+    the sharded weight-update layout — the optimizer then updates only
+    each replica's shard, and the bucketed all-gather
+    (``make_bucketed_gather``) brings the param updates back."""
     from .mesh import batch_shard_count, shard_map_compat
     from ..train.loop import make_ce_fn
     from ..train.optimizers import loss_weight_decay
@@ -274,6 +307,12 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
     def grad_fn(params, batch_stats, images, labels, apply_fn):
         n_global = images.shape[0]
         pspecs = _param_specs(params, mesh)
+        if zero1_min_size is not None:
+            from .sharding import zero1_grad_specs
+            gout_specs = zero1_grad_specs(params, mesh,
+                                          min_size=zero1_min_size)
+        else:
+            gout_specs = pspecs
         bs_specs = jax.tree_util.tree_map(lambda _: P(), batch_stats)
 
         def body(params_l, bstats, images_l, labels_l):
@@ -318,6 +357,7 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
             # docstring)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
             spec_leaves = treedef.flatten_up_to(pspecs)
+            z1_leaves = treedef.flatten_up_to(gout_specs)
             leaf_bytes = [int(np.prod(np.shape(g)) *
                               np.dtype(g.dtype).itemsize) for g in leaves]
             buckets = plan_buckets(leaf_bytes, plan.bucket_bytes)
@@ -337,7 +377,8 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                     if anchor is not None:
                         vals, _ = lax.optimization_barrier((vals, anchor))
                     exchanged = _exchange_bucket(
-                        vals, [spec_leaves[i] for i in b])
+                        vals, [spec_leaves[i] for i in b],
+                        out_specs=[z1_leaves[i] for i in b])
                     anchor = exchanged[0]
                     for i, v in zip(b, exchanged):
                         out_leaves[i] = v
@@ -349,9 +390,69 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
         sharded = shard_map_compat(
             body, mesh,
             in_specs=(pspecs, bs_specs, batch_spec, batch_spec),
-            out_specs=(P(), P(), batch_spec, bs_specs, pspecs))
+            out_specs=(P(), P(), batch_spec, bs_specs, gout_specs))
         loss, ce, logits, new_bs, grads = sharded(params, batch_stats,
                                                   images, labels)
         return (loss, (ce, logits, new_bs)), grads
 
     return grad_fn
+
+
+def make_bucketed_gather(plan: OverlapPlan, mesh: Mesh,
+                         zero1_specs: Any) -> Callable:
+    """The ZeRO-1 return leg, bucketed: ``gather(updates) -> updates`` —
+    all-gather each data-sharded param-UPDATE leaf back to its base param
+    layout, one ``lax.all_gather`` issue per bucket (the SAME greedy
+    reverse-order plan the gradient exchange uses, ``plan_buckets``),
+    buckets chained through ``optimization_barrier`` so the scheduler can
+    overlap each gather with the optimizer arithmetic still producing
+    later buckets' updates. Leaves the rule table left replicated pass
+    through untouched. The gather payload plan is recorded into
+    ``parallel.sharding.zero1_stats`` (the ``zero1`` metrics row /
+    bench's payload accounting)."""
+    from .mesh import shard_map_compat
+    from .sharding import zero1_stats
+
+    def gather(updates):
+        flat, treedef = jax.tree_util.tree_flatten(updates)
+        specs = treedef.flatten_up_to(zero1_specs)
+        z1_dims = [_axis_dim(s, "data") for s in specs]
+        # only the GATHERED leaves ride the bucket chain — a replicated
+        # pass-through leaf in a bucket would contribute no collective,
+        # and anchoring the next barrier on it would let XLA re-merge
+        # adjacent buckets' gathers. Bucket by FULL-leaf bytes: that is
+        # the all-gather output payload.
+        gidx = [i for i, d in enumerate(z1_dims) if d is not None]
+        gbytes = [int(np.prod(np.shape(flat[i])) *
+                      np.dtype(flat[i].dtype).itemsize) for i in gidx]
+        buckets = [[gidx[j] for j in b]
+                   for b in plan_buckets(gbytes, plan.bucket_bytes)]
+        leaf_bytes = {i: nb for i, nb in zip(gidx, gbytes)}
+        gathered_sizes = [sum(leaf_bytes[i] for i in b) for b in buckets]
+        zero1_stats.record_gather(gathered_sizes,
+                                  [len(b) for b in buckets])
+        base_specs = [P(*(None if n == "data" else n for n in s))
+                      if d is not None else s
+                      for s, d in zip(specs, z1_dims)]
+
+        def body(*leaves):
+            out: List[Any] = list(leaves)  # pass-throughs stay as-is
+            anchor = None
+            for b, nbytes in zip(buckets, gathered_sizes):
+                with span("zero1.gather", bytes=int(nbytes)):
+                    vals = [leaves[i] for i in b]
+                    if anchor is not None:
+                        vals, _ = lax.optimization_barrier((vals, anchor))
+                    for i, v in zip(b, vals):
+                        out[i] = lax.all_gather(v, "data",
+                                                axis=z1_dims[i],
+                                                tiled=True)
+                    anchor = out[b[0]]
+            return tuple(out)
+
+        sharded = shard_map_compat(body, mesh,
+                                   in_specs=tuple(specs),
+                                   out_specs=tuple(base_specs))
+        return jax.tree_util.tree_unflatten(treedef, sharded(*flat))
+
+    return gather

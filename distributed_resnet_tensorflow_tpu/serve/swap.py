@@ -138,12 +138,26 @@ class CheckpointSwapper:
         try:
             # restore to HOST (no abstract target -> numpy leaves): the
             # dispatch thread owns all device placement (module docstring)
-            tree = self._ckptr.restore(_payload_path(step_dir))
-            host = {
-                "step": int(np.asarray(tree["step"])),
-                "params": tree["params"],
-                "batch_stats": tree["batch_stats"],
-            }
+            from ..checkpoint import shards as shards_mod
+            if shards_mod.is_sharded_layout(step_dir):
+                # per-host sharded layout (a trainer with
+                # checkpoint.sharded on): reassemble the serving subtrees
+                # from the shard indexes — the optimizer shards this
+                # replica never needs are not even read
+                with shards_mod.ShardReader(step_dir) as reader:
+                    host = {
+                        "step": int(np.asarray(
+                            reader.read_subtree("step"))),
+                        "params": reader.read_subtree("params"),
+                        "batch_stats": reader.read_subtree("batch_stats"),
+                    }
+            else:
+                tree = self._ckptr.restore(_payload_path(step_dir))
+                host = {
+                    "step": int(np.asarray(tree["step"])),
+                    "params": tree["params"],
+                    "batch_stats": tree["batch_stats"],
+                }
         except Exception as e:  # torn pre-manifest payloads land here
             return self._reject(step, f"deserialization failed: "
                                       f"{type(e).__name__}: {e}")

@@ -138,6 +138,21 @@ def summarize_stream(stream_dir: str, now: Optional[float] = None) -> dict:
     if dump is not None:
         out["trace_dump"] = {"reason": dump.get("reason"),
                              "path": dump.get("path")}
+    cs = _last(rows, "ckpt_shard")
+    if cs is not None:
+        out["ckpt_shard"] = {
+            "process": cs.get("process"),
+            "shard_bytes": cs.get("shard_bytes"),
+            "shard_files": cs.get("shard_files"),
+            "shard_seconds": cs.get("shard_seconds"),
+            "last_committed_step": cs.get("last_committed_step")}
+    z1 = _last(rows, "zero1")
+    if z1 is not None:
+        out["zero1"] = {
+            "data_shards": z1.get("data_shards"),
+            "bytes_per_replica": z1.get("bytes_per_replica"),
+            "bytes_per_replica_unsharded":
+                z1.get("bytes_per_replica_unsharded")}
     cr = _last(rows, "corrupt_record")
     if cr is not None:
         out["corrupt_records"] = cr.get("count")
@@ -213,6 +228,25 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
     ckpt = _checkpoint_step(root)
     if ckpt is not None:
         out["last_committed_step"] = ckpt
+    # per-host sharded-checkpoint rollup: each process's ckpt_shard rows
+    # (chief in its train stream, peers in train-p<idx>) sum to the
+    # cluster's staged shard bytes — the number that shows host-balanced
+    # sharded saves are actually host-balanced
+    shard_hosts = {name: s["ckpt_shard"] for name, s in streams.items()
+                   if "ckpt_shard" in s}
+    if shard_hosts:
+        by_host = {}
+        for row in shard_hosts.values():
+            pid = str(row.get("process", "?"))
+            prev = by_host.get(pid)
+            if prev is None or (row.get("shard_bytes") or 0) > \
+                    (prev.get("shard_bytes") or 0):
+                by_host[pid] = row
+        out["ckpt_shard_bytes_by_host"] = {
+            pid: row.get("shard_bytes") for pid, row in
+            sorted(by_host.items())}
+        out["ckpt_shard_bytes_total"] = sum(
+            row.get("shard_bytes") or 0 for row in by_host.values())
     # headline: the fastest train-shaped stream is the chief's
     rates = {name: s["steps_per_sec"] for name, s in streams.items()
              if "steps_per_sec" in s}
@@ -243,6 +277,14 @@ def render(agg: dict) -> str:
     if "last_committed_step" in agg:
         lines.append(f"  checkpoint: step {agg['last_committed_step']} "
                      "committed")
+    if "ckpt_shard_bytes_total" in agg:
+        per_host = agg.get("ckpt_shard_bytes_by_host", {})
+        mb = agg["ckpt_shard_bytes_total"] / 1e6
+        lines.append(
+            f"  ckpt shards: {mb:.1f} MB staged across "
+            f"{len(per_host)} host(s) " + " ".join(
+                f"p{pid}:{(b or 0) / 1e6:.1f}MB"
+                for pid, b in per_host.items()))
     if "hosts" in agg:
         lines.append(f"  hosts ({len(agg['hosts'])}; "
                      f"skew {agg.get('host_step_skew', 0)} steps):")

@@ -49,7 +49,10 @@ log = logging.getLogger(__name__)
 
 #: bump when the trace.json event shape changes (consumers key on it via
 #: the ``trace_dump`` metrics row and the file's otherData block)
-SPAN_SCHEMA_VERSION = 3  # 3: + checkpoint.snapshot/checkpoint.writer/
+SPAN_SCHEMA_VERSION = 4  # 4: + checkpoint.shard/checkpoint.finalize/
+#                              zero1.gather (ZeRO-1 sharded update +
+#                              per-host sharded checkpoints, round 11)
+#                          3: + checkpoint.snapshot/checkpoint.writer/
 #                              comm.bucket (zero-stall step loop, round 10)
 
 #: every span name the framework emits — register HERE first (the
@@ -88,6 +91,12 @@ SPAN_CATALOG = {
                          "ckpt_async row, NOT goodput checkpoint)",
     "checkpoint.stage": "orbax serialization into the staging dir "
                         "(writer thread when async)",
+    "checkpoint.shard": "this host's per-host shard files staged + "
+                        "fsynced (sharded layout; writer thread)",
+    "checkpoint.finalize": "sharded multi-process finalize: marker-file "
+                           "wait for peer shards, then manifest + "
+                           "commit rename (chief writer) or the wait "
+                           "for the chief's commit (peers)",
     "checkpoint.fsync": "manifest write + fsync",
     "checkpoint.commit": "atomic rename + parent-dir fsync",
     "restore": "checkpoint restore into the live state (goodput: restart "
@@ -96,6 +105,8 @@ SPAN_CATALOG = {
     "comm.bucket": "one planned gradient-exchange bucket (recorded at "
                    "step TRACE time with bytes/leaves args — the bucket "
                    "plan, not a per-step event)",
+    "zero1.gather": "one planned ZeRO-1 param-update all-gather bucket "
+                    "(trace-time, like comm.bucket — the gather plan)",
     # serving (serve/server.py, serve/swap.py)
     "serve.batch": "one bucket dispatch: stage + AOT predict + resolve",
     "serve.swap_restore": "off-path host restore of a newer checkpoint",

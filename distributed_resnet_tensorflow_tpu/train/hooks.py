@@ -232,6 +232,70 @@ class CkptAsyncHook(_CadenceHook):
                                     {"step": int(step), **snap})
 
 
+class CkptShardHook(_CadenceHook):
+    """Export THIS host's sharded-checkpoint accounting as
+    ``{"event": "ckpt_shard"}`` rows every N steps when its shard bytes
+    advanced — the per-host view ``main.py monitor`` rolls up into
+    cluster shard-byte totals. Unlike the chief-only observability
+    hooks this runs on EVERY process (each host stages only its own
+    shard; the chief's row alone would claim the cluster wrote 1/N of
+    what it did). Writes nothing on the single-payload layout."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+        self._exported: Dict[str, Any] = {}
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        from ..utils.metrics import ckpt_async_stats
+        snap = ckpt_async_stats.snapshot()
+        # gate on the WHOLE row changing, not just shard_bytes (the
+        # CkptAsyncHook lesson): a row exported while the writer sat in
+        # the finalize wait would otherwise freeze last_committed_step /
+        # finalize_wait_seconds at their mid-commit values forever —
+        # exactly the final save of every run
+        row = {"process": jax.process_index(),
+               "shard_bytes": snap["shard_bytes"],
+               "shard_files": snap["shard_files"],
+               "shard_seconds": snap["shard_seconds"],
+               "finalize_wait_seconds": snap["finalize_wait_seconds"],
+               "last_committed_step": snap["last_committed_step"]}
+        if snap["shard_files"] and row != self._exported:
+            self._exported = row
+            self.writer.write_event("ckpt_shard",
+                                    {"step": int(step), **row})
+
+
+class Zero1Hook(_CadenceHook):
+    """Export the ZeRO-1 partition plan (parallel/sharding.zero1_stats:
+    sharded/replicated leaf+byte counts, per-replica optimizer bytes,
+    fallback reasons, and — under comm.overlap — the bucketed param-
+    update all-gather plan) as ONE ``{"event": "zero1"}`` row per
+    resolved plan, the comm_overlap contract: the plan is a property of
+    the compiled step. Writes nothing when optimizer.zero1 resolved
+    off."""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+        self._exported: Dict[str, Any] = {}
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        from ..parallel.sharding import zero1_stats
+        snap = zero1_stats.snapshot()
+        if snap is not None and snap != self._exported:
+            self._exported = snap
+            self.writer.write_event("zero1", {"step": int(step), **snap})
+
+
 class CommOverlapHook(_CadenceHook):
     """Export the bucketed gradient-exchange plan (parallel/overlap.
     overlap_stats) as ONE ``{"event": "comm_overlap"}`` row per traced

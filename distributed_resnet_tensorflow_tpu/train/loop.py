@@ -118,7 +118,8 @@ def make_train_step(schedule: Callable, weight_decay: float,
                     augment_fn: Optional[Callable] = None,
                     augment_seed: int = 0,
                     aux_loss_weight: float = 0.01,
-                    value_and_grad_fn: Optional[Callable] = None):
+                    value_and_grad_fn: Optional[Callable] = None,
+                    apply_gradients_fn: Optional[Callable] = None):
     """Build the pure train_step(state, batch) -> (state, metrics).
 
     ``augment_fn(images, rng) -> images`` runs device-side augmentation at
@@ -130,13 +131,21 @@ def make_train_step(schedule: Callable, weight_decay: float,
     custom gradient strategy sharing its exact signature/aux contract —
     the bucketed-overlap exchange (parallel/overlap.make_bucketed_grad)
     plugs in here. Incompatible with grad_accum_steps > 1 (the
-    accumulation scan exchanges once per accumulated batch)."""
+    accumulation scan exchanges once per accumulated batch).
+
+    ``apply_gradients_fn(state, grads) -> state`` replaces the default
+    ``state.apply_gradients(grads)`` — the ZeRO-1 sharded weight update
+    (Trainer._make_zero1_apply: reduce-scattered grads → local optimizer
+    shard update → all-gathered param updates) plugs in here."""
     if ce_fn is None:
         ce_fn = make_ce_fn(label_smoothing)
     if value_and_grad_fn is not None and grad_accum_steps > 1:
         raise ValueError(
             "a custom value_and_grad_fn (comm.overlap) is incompatible "
             "with train.grad_accum_steps > 1")
+    if apply_gradients_fn is None:
+        apply_gradients_fn = lambda state, grads: \
+            state.apply_gradients(grads)  # noqa: E731
 
     def prep(images, step, midx=None):
         if augment_fn is None:
@@ -170,7 +179,8 @@ def make_train_step(schedule: Callable, weight_decay: float,
             else jax.value_and_grad(loss_fn, has_aux=True)
         (loss, (ce, logits, new_bs)), grads = grad_fn(
             state.params, state.batch_stats, images, labels, state.apply_fn)
-        new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        new_state = apply_gradients_fn(state, grads).replace(
+            batch_stats=new_bs)
         precision = jnp.mean(
             (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
         metrics = {
@@ -215,7 +225,8 @@ def make_train_step(schedule: Callable, weight_decay: float,
             body, (zero_grads, 0.0, 0.0, state.batch_stats),
             (images, labels, jnp.arange(n)))
         grads = jax.tree_util.tree_map(lambda g: g / n, grads)
-        new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        new_state = apply_gradients_fn(state, grads).replace(
+            batch_stats=new_bs)
         metrics = {
             "loss": losses.mean(), "cross_entropy": ce_sum / n,
             "precision": prec_sum / n, "learning_rate": schedule(state.step),
@@ -303,6 +314,15 @@ class Trainer:
         from ..parallel.overlap import BATCH_AXES, resolve_overlap
         self._overlap = resolve_overlap(cfg, self.mesh)
         bn_axis_name = BATCH_AXES if self._overlap is not None else None
+        # ZeRO-1 sharded weight update (arXiv:2004.13336; parallel/
+        # sharding.py rule table): optimizer state shards over `data`,
+        # gradients reduce-scatter into the shard layout, the update runs
+        # on 1/N state per replica, param updates all-gather back.
+        # optimizer.zero1=on raises here when the (mesh) is outside the
+        # envelope; the replicated (off) path stays bit-identical to the
+        # pre-ZeRO step — the exactness oracle the tests pin against.
+        from ..parallel.sharding import resolve_zero1
+        self._zero1 = resolve_zero1(cfg, self.mesh)
         # cross_replica_bn=True (default): global BN moments — one group.
         # False: reference-faithful per-replica BN — one moment group per
         # batch shard (see ops/batch_norm.py).
@@ -480,6 +500,56 @@ class Trainer:
             self._put_train_batch = self._put_batch
             self._put_train_multi_batch = self._put_multi_batch
 
+    def _zero1_min_size(self) -> int:
+        from ..parallel.sharding import ZERO1_MIN_SIZE
+        return self.cfg.optimizer.zero1_min_size or ZERO1_MIN_SIZE
+
+    def _state_shardings(self, shapes):
+        """state_shardings with this Trainer's resolved ZeRO-1 choice —
+        the ONE resolution point every jitted entry uses, so the live
+        state, the jit in/out shardings and the grad constraint cannot
+        disagree about the optimizer layout."""
+        return state_shardings(shapes, self.mesh, zero1=self._zero1,
+                               zero1_min_size=self._zero1_min_size())
+
+    def _make_zero1_apply(self):
+        """The ZeRO-1 weight update, ``(state, grads) -> state``:
+        gradients pinned to the rule-table shard layout (on the jit path
+        the ``with_sharding_constraint`` turns the all-reduce XLA would
+        emit into reduce-scatter — the arXiv:2004.13336 transformation;
+        on the overlap path the bucketed exchange already reduce-scattered
+        them), the optimizer transform then runs on each replica's 1/N
+        shard (cross-shard reductions like the LARS/LAMB trust-ratio
+        norms get their collectives from sharding propagation), and the
+        param updates return to the base layout — through the bucketed
+        all-gather when the overlap path is active, else through the jit
+        output sharding's gather."""
+        mesh = self.mesh
+        min_size = self._zero1_min_size()
+        plan = self._overlap
+
+        def apply_gradients_fn(state, grads):
+            from jax.lax import with_sharding_constraint
+            from ..parallel.sharding import zero1_grad_specs
+            specs = zero1_grad_specs(state.params, mesh,
+                                     min_size=min_size)
+            shard_tree = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P))
+            grads = with_sharding_constraint(grads, shard_tree)
+            updates, new_opt = state.tx.update(grads, state.opt_state,
+                                               state.params)
+            updates = with_sharding_constraint(updates, shard_tree)
+            if plan is not None:
+                from ..parallel.overlap import make_bucketed_gather
+                updates = make_bucketed_gather(plan, mesh, specs)(updates)
+            import optax as _optax
+            new_params = _optax.apply_updates(state.params, updates)
+            return state.replace(step=state.step + 1, params=new_params,
+                                 opt_state=new_opt)
+
+        return apply_gradients_fn
+
     def _build_train_step(self, aug_fn):
         cfg = self.cfg
         vag = None
@@ -496,7 +566,9 @@ class Trainer:
                 decay_all_params=cfg.optimizer.decay_all_params,
                 label_smoothing=cfg.optimizer.label_smoothing,
                 fused_xent=cfg.train.fused_xent,
-                aux_loss_weight=cfg.model.moe_aux_weight)
+                aux_loss_weight=cfg.model.moe_aux_weight,
+                zero1_min_size=self._zero1_min_size()
+                if self._zero1 else None)
         return make_train_step(
             self.schedule, cfg.optimizer.weight_decay,
             cfg.optimizer.label_smoothing,
@@ -507,13 +579,21 @@ class Trainer:
                              cfg.train.fused_xent, self.mesh),
             augment_fn=aug_fn, augment_seed=cfg.train.seed,
             aux_loss_weight=cfg.model.moe_aux_weight,
-            value_and_grad_fn=vag)
+            value_and_grad_fn=vag,
+            apply_gradients_fn=self._make_zero1_apply()
+            if self._zero1 else None)
 
     @property
     def comm_overlap_active(self) -> bool:
         """True when the train step exchanges gradients through the
         bucketed overlap path (parallel/overlap.py)."""
         return self._overlap is not None
+
+    @property
+    def zero1_active(self) -> bool:
+        """True when the optimizer state and weight update are sharded
+        over the ``data`` axis (parallel/sharding.py ZeRO-1 rule table)."""
+        return self._zero1
 
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:
@@ -524,15 +604,16 @@ class Trainer:
         nb = batch_shard_count(self.mesh)
         shape = (nb, c.data.image_size, c.data.image_size, 3) \
             if c.model.name != "logistic" else (nb, c.model.input_size)
-        self.state = create_train_state(rng, self.model, self.tx, shape,
-                                        mesh=self.mesh)
+        self.state = create_train_state(
+            rng, self.model, self.tx, shape, mesh=self.mesh,
+            zero1=self._zero1, zero1_min_size=self._zero1_min_size())
         return self.state
 
     # -- jitted steps ------------------------------------------------------
     def jitted_train_step(self):
         if self._jitted_train is None:
             shapes = jax.eval_shape(lambda s: s, self.state)
-            st_sh = state_shardings(shapes, self.mesh)
+            st_sh = self._state_shardings(shapes)
             b_sh = data_sharding(self.mesh)
             self._jitted_train = jax.jit(
                 self._train_step,
@@ -591,7 +672,7 @@ class Trainer:
                 return state, last
 
             shapes = jax.eval_shape(lambda s: s, self.state)
-            st_sh = state_shardings(shapes, self.mesh)
+            st_sh = self._state_shardings(shapes)
             b_sh = NamedSharding(
                 self.mesh, P(None, *data_sharding(self.mesh).spec))
             self._jitted_multi = jax.jit(
@@ -683,7 +764,7 @@ class Trainer:
         if self._jitted_idx is None:
             from ..parallel.mesh import replicated
             shapes = jax.eval_shape(lambda s: s, self.state)
-            st_sh = state_shardings(shapes, self.mesh)
+            st_sh = self._state_shardings(shapes)
             b_sh = data_sharding(self.mesh)
             rep = replicated(self.mesh)
             jit_fn = jax.jit(
@@ -733,7 +814,7 @@ class Trainer:
                 return state, last
 
             shapes = jax.eval_shape(lambda s: s, self.state)
-            st_sh = state_shardings(shapes, self.mesh)
+            st_sh = self._state_shardings(shapes)
             b_sh = NamedSharding(
                 self.mesh, P(None, *data_sharding(self.mesh).spec))
             rep = replicated(self.mesh)

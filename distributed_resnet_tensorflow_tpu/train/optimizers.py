@@ -57,6 +57,18 @@ def create_optimizer(opt_cfg, schedule: Callable) -> optax.GradientTransformatio
             trust_coefficient=opt_cfg.lars_trust_coefficient,
             eps=opt_cfg.lars_eps,
             momentum=opt_cfg.momentum))
+    elif name == "lamb":
+        # LAMB (arXiv:1904.00962): Adam moments + LARS-style per-layer
+        # trust ratio, decoupled decay — the large-batch recipe for the
+        # bs>=4k presets (arXiv:1811.05233's warmup pairs with it). The
+        # same non-BN/bias mask as LARS/AdamW: normalization scales and
+        # biases get neither decay nor trust-ratio scaling. Doubles the
+        # moment state (m AND v) — which is why the lamb presets turn on
+        # optimizer.zero1 (the moments shard across the data axis).
+        chain.append(optax.lamb(
+            schedule,
+            weight_decay=opt_cfg.weight_decay,
+            mask=_non_bn_mask))
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     return optax.chain(*chain) if len(chain) > 1 else chain[0]
@@ -64,10 +76,10 @@ def create_optimizer(opt_cfg, schedule: Callable) -> optax.GradientTransformatio
 
 def decoupled_decay(name: str) -> bool:
     """True for optimizers that take weight decay INSIDE the update (LARS,
-    AdamW) — the train loop must then skip the loss-side L2, and
+    LAMB, AdamW) — the train loop must then skip the loss-side L2, and
     ``decay_all_params`` (a loss-path switch) is rejected. The single
     predicate behind both decisions (train/loop.py)."""
-    return name in ("lars", "adamw")
+    return name in ("lars", "lamb", "adamw")
 
 
 def _non_bn_mask(params):

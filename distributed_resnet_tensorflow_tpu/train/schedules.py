@@ -68,6 +68,36 @@ def warmup_cosine(warmup_steps: int, peak: float, total_steps: int,
         decay_steps=total_steps, end_value=end_value)
 
 
+def warmup_poly(warmup_steps: int, peak: float, total_steps: int,
+                power: float = 2.0, end_value: float = 0.0) -> Schedule:
+    """Linear warmup to ``peak`` then polynomial decay to ``end_value`` —
+    the LARS large-batch recipe (arXiv:1708.03888 trains with poly(2)
+    decay; arXiv:1711.04325 and 1811.05233 pair it with a linear warmup of
+    ~5 epochs to cross the bs>512 accuracy cliff). Pure ``step -> lr``
+    like every schedule here."""
+    warmup_steps = max(warmup_steps, 1)
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = peak * jnp.clip(step / warmup_steps, 0.0, 1.0)
+        frac = jnp.clip((step - warmup_steps)
+                        / jnp.maximum(total_steps - warmup_steps, 1),
+                        0.0, 1.0)
+        decay = (peak - end_value) * (1.0 - frac) ** power + end_value
+        return jnp.where(step < warmup_steps, warm, decay)
+
+    return fn
+
+
+def linear_scaled_lr(base_lr: float, batch_size: int,
+                     base_batch: int = 256) -> float:
+    """The linear LR scaling rule (arXiv:1711.04325 §2, after Goyal et
+    al.): lr = base_lr × batch/base_batch. The warmup presets quote their
+    peak LRs directly; this helper is for ad-hoc ``--set`` overrides that
+    change the global batch and need the matched peak."""
+    return base_lr * batch_size / base_batch
+
+
 def constant(value: float) -> Schedule:
     return lambda step: jnp.asarray(value, jnp.float32)
 
@@ -84,6 +114,9 @@ def create_schedule(opt_cfg) -> Schedule:
     if name == "cosine":
         return warmup_cosine(opt_cfg.warmup_steps, opt_cfg.learning_rate,
                              opt_cfg.total_steps)
+    if name == "warmup_poly":
+        return warmup_poly(opt_cfg.warmup_steps, opt_cfg.learning_rate,
+                           opt_cfg.total_steps)
     if name == "constant":
         return constant(opt_cfg.learning_rate)
     raise ValueError(f"unknown schedule {name!r}")

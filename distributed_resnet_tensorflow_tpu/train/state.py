@@ -59,7 +59,8 @@ def abstract_train_state(model, tx, input_shape) -> TrainState:
 
 
 def create_train_state(rng: jax.Array, model, tx, input_shape,
-                       mesh: Mesh = None) -> TrainState:
+                       mesh: Mesh = None, zero1: bool = False,
+                       zero1_min_size: int = 0) -> TrainState:
     """Initialize model + optimizer state.
 
     When a mesh is given, init runs under jit with output shardings so large
@@ -68,6 +69,10 @@ def create_train_state(rng: jax.Array, model, tx, input_shape,
     resnet_cifar_main.py:392-396) and Horovod's rank-0 variable broadcast
     (reference resnet_cifar_main_horovod.py:316): replicated init is identical
     on every process by seeded construction.
+
+    ``zero1=True`` lays the optimizer state out in the ZeRO-1 rule-table
+    sharding (``parallel/sharding.zero1_state_shardings``): each data
+    replica materializes only its 1/N optimizer shard from step 0.
     """
     init_fn = _make_init_fn(model, tx, input_shape)
     if mesh is None:
@@ -75,20 +80,39 @@ def create_train_state(rng: jax.Array, model, tx, input_shape,
 
     # Evaluate shapes, derive shardings, then jit-init with those outputs.
     abstract = jax.eval_shape(init_fn, rng)
-    shardings = state_shardings(abstract, mesh)
+    shardings = state_shardings(abstract, mesh, zero1=zero1,
+                                zero1_min_size=zero1_min_size)
     jit_init = jax.jit(init_fn, out_shardings=shardings)
     return jit_init(rng)
 
 
-def state_shardings(state_shapes, mesh: Mesh):
+def state_shardings(state_shapes, mesh: Mesh, zero1: bool = False,
+                    zero1_min_size: int = 0):
     """NamedShardings for every leaf of a TrainState (params/opt_state follow
-    the fsdp rule; step/batch_stats replicated)."""
+    the fsdp rule; step/batch_stats replicated).
+
+    ``zero1=True`` additionally shards the optimizer state over the
+    ``data`` axis via the regex→PartitionSpec rule table
+    (``parallel/sharding.zero1_state_shardings``, arXiv:2004.13336); each
+    resolution records its counted partition report into the process-global
+    ``parallel.sharding.zero1_stats``. Params stay replicated-per-fsdp —
+    ZeRO-1 shards the UPDATE and its state, not the forward weights."""
     param_sh = tree_param_shardings(state_shapes.params, mesh)
     rep = NamedSharding(mesh, P())
-    # optimizer moments mirror the param tree INCLUDING names (optax states
-    # embed the param pytree), so the name-aware rule (fsdp + tensor) applies
-    # to them identically; scalar counters fall through to replicated
-    opt_sh = tree_param_shardings(state_shapes.opt_state, mesh)
+    if zero1:
+        from ..parallel.sharding import (ZERO1_MIN_SIZE, Zero1Report,
+                                         zero1_state_shardings, zero1_stats)
+        report = Zero1Report(mesh.shape.get("data", 1))
+        opt_sh = zero1_state_shardings(
+            state_shapes.opt_state, mesh,
+            min_size=zero1_min_size or ZERO1_MIN_SIZE, report=report)
+        zero1_stats.record_report(report)
+    else:
+        # optimizer moments mirror the param tree INCLUDING names (optax
+        # states embed the param pytree), so the name-aware rule (fsdp +
+        # tensor) applies to them identically; scalar counters fall
+        # through to replicated
+        opt_sh = tree_param_shardings(state_shapes.opt_state, mesh)
     bs_sh = jax.tree_util.tree_map(lambda _: rep, state_shapes.batch_stats)
     return TrainState(step=rep, params=param_sh, batch_stats=bs_sh,
                       opt_state=opt_sh, apply_fn=state_shapes.apply_fn,

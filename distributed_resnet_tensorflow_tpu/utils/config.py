@@ -180,14 +180,30 @@ class OptimizerConfig:
     warmup+piecewise for ImageNet (resnet_imagenet_main.py:236-247).
     Adds LARS for large-batch (bs=32k) scaling."""
 
-    name: str = "momentum"            # sgd | momentum | adam | adamw | lars
+    name: str = "momentum"            # sgd | momentum | adam | adamw | lars | lamb
     momentum: float = 0.9
     learning_rate: float = 0.1
     weight_decay: float = 2e-4        # cifar train value (reference resnet_cifar_main.py:99); imagenet: 1e-4
     # True = reference-faithful L2 over ALL trainables incl. BN scale/bias
     # (reference resnet_model.py:85-86); False (default) = kernels only
     decay_all_params: bool = False
-    # schedule: piecewise | warmup_piecewise | cosine | constant
+    # -- ZeRO-1 sharded weight update (parallel/sharding.py rule table +
+    # train/loop.py; arXiv:2004.13336) ---------------------------------
+    # shard the optimizer state and the weight update across the `data`
+    # mesh axis: gradients reduce-scatter into each replica's optimizer
+    # shard, the update runs on 1/N of the state per replica, and the
+    # parameter updates all-gather back (bucketed when comm.overlap is
+    # active). auto = on iff the run has >1 process (where per-replica
+    # optimizer memory is the binding constraint); on = force (raises the
+    # unsupported reason outside the envelope); off = the replicated
+    # update — the bit-identical exactness oracle the ZeRO-1 path is
+    # tested against
+    zero1: str = "off"                # auto | on | off
+    # leaves smaller than this many ELEMENTS stay replicated under ZeRO-1
+    # (a sharded BN-scale moment buys nothing and costs a collective);
+    # counted in the zero1 partition report
+    zero1_min_size: int = 2048
+    # schedule: piecewise | warmup_piecewise | cosine | warmup_poly | constant
     schedule: str = "piecewise"
     boundaries: Tuple[int, ...] = (40000, 60000, 80000)      # reference resnet_cifar_main.py:298-307
     values: Tuple[float, ...] = (0.1, 0.01, 0.001, 0.0001)
@@ -260,6 +276,20 @@ class CheckpointConfig:
     max_to_keep: int = 5
     async_save: bool = True
     resume: bool = True               # auto-resume from latest
+    # -- per-host SHARDED checkpoints (checkpoint/shards.py) -------------
+    # each host stages + fsyncs only the state shards its own devices
+    # address (the ZeRO-1 optimizer shard, fsdp param shards) plus a
+    # chief-written base of the replicated leaves, all under the existing
+    # manifest/commit protocol; the multi-process finalize coordinates
+    # over marker FILES on the shared directory — no collectives on the
+    # writer thread, so multi-process saves can finally run async.
+    # Restore re-assembles leaves from whatever host count wrote them and
+    # re-shards into the live state's rule-table layout. auto = on iff
+    # the run has >1 process; off = the single-payload orbax layout
+    sharded: str = "auto"             # auto | on | off
+    # how long a sharded save's finalize may wait on peer-host shard
+    # markers (and peers on the chief's commit) before failing the save
+    finalize_timeout_secs: float = 300.0
 
 
 @dataclass
@@ -588,14 +618,68 @@ def _imagenet_resnet50() -> ExperimentConfig:
 
 
 def _imagenet_resnet50_lars32k() -> ExperimentConfig:
-    """Large-batch: bs=32k + LARS (BASELINE.json config 5)."""
+    """Large-batch: bs=32k + LARS (BASELINE.json config 5). ZeRO-1 resolves
+    on under multi-process (auto): at this scale the per-replica optimizer
+    state, not FLOPs, caps what fits (arXiv:2004.13336)."""
     cfg = _imagenet_resnet50()
     cfg.optimizer = OptimizerConfig(
         name="lars", learning_rate=29.0, weight_decay=1e-4,
-        schedule="cosine",
+        schedule="cosine", zero1="auto",
         warmup_steps=800, total_steps=3600, label_smoothing=0.1)
     cfg.train = TrainConfig(batch_size=32768, train_steps=3600,
                             log_every_steps=10)
+    return cfg
+
+
+#: ImageNet train-set size — the epoch↔step conversion the large-batch
+#: warmup recipes are specified in (arXiv:1711.04325 / 1811.05233 give
+#: warmup in EPOCHS; steps depend on the global batch)
+IMAGENET_TRAIN_IMAGES = 1_281_167
+
+
+def large_batch_steps(batch_size: int, epochs: float) -> int:
+    """Steps covering ``epochs`` ImageNet epochs at ``batch_size`` — the
+    one conversion both large-batch presets and ad-hoc ``--set`` overrides
+    use, so a changed batch size keeps the epoch budget."""
+    return max(1, round(epochs * IMAGENET_TRAIN_IMAGES / batch_size))
+
+
+def _imagenet_resnet50_lars4k() -> ExperimentConfig:
+    """Large-batch bs=4096 + LARS, the arXiv:1711.04325 / 1811.05233
+    recipe shape: 5-epoch linear warmup (the cure for the bs>512 accuracy
+    cliff the reference README documents at 32k), polynomial(2) decay to
+    zero over 90 epochs, label smoothing 0.1. ZeRO-1 on: the optimizer
+    state shards across the data axis (arXiv:2004.13336), so per-replica
+    memory stops scaling with the replica count's optimizer copies."""
+    cfg = _imagenet_resnet50()
+    bs = 4096
+    cfg.optimizer = OptimizerConfig(
+        name="lars", learning_rate=13.0, weight_decay=1e-4,
+        schedule="warmup_poly", zero1="on",
+        warmup_steps=large_batch_steps(bs, 5),
+        total_steps=large_batch_steps(bs, 90), label_smoothing=0.1)
+    cfg.train = TrainConfig(batch_size=bs,
+                            train_steps=large_batch_steps(bs, 90),
+                            log_every_steps=20)
+    return cfg
+
+
+def _imagenet_resnet50_lamb4k() -> ExperimentConfig:
+    """Large-batch bs=4096 + LAMB (trust-ratio-scaled Adam): the same
+    5-epoch linear warmup + 90-epoch budget as the LARS recipe, cosine
+    decay (LAMB's usual pairing). ZeRO-1 on — LAMB doubles the moment
+    state (m AND v per param), which is exactly the memory the sharded
+    update exists to split."""
+    cfg = _imagenet_resnet50()
+    bs = 4096
+    cfg.optimizer = OptimizerConfig(
+        name="lamb", learning_rate=10.0, weight_decay=1e-4,
+        schedule="cosine", zero1="on",
+        warmup_steps=large_batch_steps(bs, 5),
+        total_steps=large_batch_steps(bs, 90), label_smoothing=0.1)
+    cfg.train = TrainConfig(batch_size=bs,
+                            train_steps=large_batch_steps(bs, 90),
+                            log_every_steps=20)
     return cfg
 
 
@@ -655,6 +739,8 @@ PRESETS = {
     "cifar100_wrn28_10": _cifar100_wrn2810,
     "imagenet_resnet50": _imagenet_resnet50,
     "imagenet_resnet50_lars32k": _imagenet_resnet50_lars32k,
+    "imagenet_resnet50_lars4k": _imagenet_resnet50_lars4k,
+    "imagenet_resnet50_lamb4k": _imagenet_resnet50_lamb4k,
     "vit_long_context": _vit_long_context,
     "vit_large_224": _vit_large_224,
     "smoke": _cifar10_smoke,

@@ -180,13 +180,17 @@ class CkptAsyncStats:
         self._lock = threading.Lock()
         self._c = dict(saves=0, committed=0, sync_saves=0, overtakes=0,
                        snapshot_seconds=0.0, backpressure_seconds=0.0,
-                       writer_seconds=0.0)
+                       writer_seconds=0.0, shard_bytes=0, shard_files=0,
+                       shard_seconds=0.0, finalize_wait_seconds=0.0)
         self.last_committed_step = -1
 
     def add(self, saves: int = 0, committed: int = 0, sync_saves: int = 0,
             overtakes: int = 0, snapshot_seconds: float = 0.0,
             backpressure_seconds: float = 0.0,
             writer_seconds: float = 0.0,
+            shard_bytes: int = 0, shard_files: int = 0,
+            shard_seconds: float = 0.0,
+            finalize_wait_seconds: float = 0.0,
             step: Optional[int] = None) -> None:
         with self._lock:
             self._c["saves"] += saves
@@ -196,6 +200,10 @@ class CkptAsyncStats:
             self._c["snapshot_seconds"] += snapshot_seconds
             self._c["backpressure_seconds"] += backpressure_seconds
             self._c["writer_seconds"] += writer_seconds
+            self._c["shard_bytes"] += shard_bytes
+            self._c["shard_files"] += shard_files
+            self._c["shard_seconds"] += shard_seconds
+            self._c["finalize_wait_seconds"] += finalize_wait_seconds
             if step is not None:
                 self.last_committed_step = max(self.last_committed_step,
                                                int(step))
@@ -211,7 +219,8 @@ class CkptAsyncStats:
             out = dict(self._c)
             out["last_committed_step"] = self.last_committed_step
         for k in ("snapshot_seconds", "backpressure_seconds",
-                  "writer_seconds"):
+                  "writer_seconds", "shard_seconds",
+                  "finalize_wait_seconds"):
             out[k] = round(out[k], 4)
         return out
 
@@ -283,7 +292,59 @@ EVENT_SCHEMAS = {
             "writer_seconds": "dedicated writer-thread stage/fsync/"
                               "commit time (overlaps compute; NOT in "
                               "the goodput checkpoint bucket)",
+            "shard_bytes": "bytes THIS host's writer staged into its "
+                           "per-host shard files (sharded layout only)",
+            "shard_files": "per-host shard files this host staged",
+            "shard_seconds": "writer time spent staging this host's "
+                             "shard files",
+            "finalize_wait_seconds": "writer time waiting on peer-host "
+                                     "shard markers / the chief's "
+                                     "commit (sharded multi-process "
+                                     "finalize)",
             "last_committed_step": "newest step the writer committed",
+        },
+    },
+    "ckpt_shard": {
+        "emitted_by": "train/hooks.py CkptShardHook (summary cadence, "
+                      "when this host's shard bytes advanced; every "
+                      "process exports — the monitor rolls hosts up)",
+        "fields": {
+            "step": "step at export time",
+            "process": "jax.process_index() of the exporting host",
+            "shard_bytes": "cumulative bytes this host staged into its "
+                           "per-host shard files",
+            "shard_files": "cumulative per-host shard files staged",
+            "shard_seconds": "cumulative writer time staging them",
+            "finalize_wait_seconds": "cumulative writer time in the "
+                                     "marker-file finalize wait",
+            "last_committed_step": "newest step committed on this host's "
+                                   "view",
+        },
+    },
+    "zero1": {
+        "emitted_by": "train/hooks.py Zero1Hook (once per resolved "
+                      "partition plan, like comm_overlap)",
+        "fields": {
+            "step": "step at export time",
+            "data_shards": "data-axis size the optimizer state shards "
+                           "over",
+            "sharded_leaves": "optimizer-state leaves sharded over data",
+            "replicated_leaves": "leaves left replicated (see reasons)",
+            "sharded_bytes": "global bytes of the sharded leaves",
+            "replicated_bytes": "global bytes of the replicated leaves",
+            "bytes_per_replica": "per-replica optimizer-state bytes "
+                                 "under this plan",
+            "bytes_per_replica_unsharded": "per-replica bytes the "
+                                           "replicated update would "
+                                           "cost (the ZeRO-1 saving's "
+                                           "denominator)",
+            "reasons": "per-reason fallback counts (below-min-size, "
+                       "no-divisible-dim, bookkeeping, ...)",
+            "gather_buckets": "param-update all-gather buckets "
+                              "(comm.overlap composition only)",
+            "gather_bucket_bytes": "per-bucket gathered bytes, issue "
+                                   "order",
+            "gather_bucket_leaves": "per-bucket gathered leaf counts",
         },
     },
     "comm_overlap": {

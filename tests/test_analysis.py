@@ -450,3 +450,65 @@ def test_elaborator_traces_bucketed_overlap_step(devices):
     assert [f for f in findings if f.rule == "elab-overlap-step"] == [], \
         [f.message for f in findings]
     assert overlap_stats.snapshot() is not None
+
+# ---------------------------------------------------------------------------
+# unsharded-opt-state rule + elab-zero1 big-mesh sweep (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def _bad_zero1_preset():
+    """Fixture preset: optimizer.zero1=on over shapes no 8-way data axis
+    divides (35/9/3 logistic) — the promise the rule exists to catch."""
+    from distributed_resnet_tensorflow_tpu.utils.config import (
+        ExperimentConfig)
+    cfg = ExperimentConfig()
+    cfg.model.name = "logistic"
+    cfg.model.input_size = 35
+    cfg.model.hidden_units = 9
+    cfg.model.num_classes = 3
+    cfg.optimizer.zero1 = "on"
+    cfg.optimizer.zero1_min_size = 8
+    return cfg
+
+
+def test_unsharded_opt_state_rule_fires_with_file_and_line(monkeypatch):
+    from types import SimpleNamespace
+    from distributed_resnet_tensorflow_tpu.analysis.rules import (
+        opt_state as rule)
+    from distributed_resnet_tensorflow_tpu.utils import config as config_mod
+    monkeypatch.setitem(config_mod.PRESETS, "bad_zero1", _bad_zero1_preset)
+    findings = [f for f in rule.check(SimpleNamespace(root=repo_root()))
+                if "bad_zero1" in f.message]
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "unsharded-opt-state"
+    # anchored at the fixture FACTORY's def line in this file
+    assert f.path.endswith("test_analysis.py")
+    assert f.line == _bad_zero1_preset.__code__.co_firstlineno
+    assert "replicated" in f.message
+
+
+def test_unsharded_opt_state_rule_clean_on_real_presets():
+    """The shipped zero1 presets (lars4k/lamb4k) must actually shard —
+    the rule passing on the real tree IS the promise check."""
+    from types import SimpleNamespace
+    from distributed_resnet_tensorflow_tpu.analysis.rules import (
+        opt_state as rule)
+    assert list(rule.check(SimpleNamespace(root=repo_root()))) == []
+
+
+def test_elab_zero1_sweep_clean_and_flags_unshardable(devices, monkeypatch):
+    """The big-mesh sweep, exercised at the test harness's 8 devices
+    (sizes is a parameter; the gate runs 64/256): a real zero1 preset
+    elaborates clean, and a preset whose shapes defeat the rule table
+    gets an elab-zero1 finding naming the fully-replicated resolution."""
+    from distributed_resnet_tensorflow_tpu.analysis.elaborate import (
+        run_elaborate_zero1)
+    from distributed_resnet_tensorflow_tpu.utils import config as config_mod
+
+    clean = run_elaborate_zero1(["imagenet_resnet50_lars4k"], sizes=(8,))
+    assert clean == [], [f.message for f in clean]
+
+    monkeypatch.setitem(config_mod.PRESETS, "bad_zero1", _bad_zero1_preset)
+    bad = run_elaborate_zero1(["bad_zero1"], sizes=(8,))
+    assert any(f.rule == "elab-zero1" and "FULLY replicated" in f.message
+               for f in bad), [f.message for f in bad]

@@ -711,3 +711,351 @@ def test_snapshot_is_host_resident(tmp_path):
     snap = _host_snapshot(_saveable(tr.state))
     for leaf in jax.tree_util.tree_leaves(snap):
         assert not isinstance(leaf, jax.Array), type(leaf)
+
+# ---------------------------------------------------------------------------
+# per-host SHARDED checkpoints (checkpoint/shards.py; ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def _zero1_trainer(tmp_path, **kw):
+    """A zero1 logistic trainer on the 8-device dp mesh: its optimizer
+    state is genuinely data-sharded, so the sharded layout has real
+    per-host pieces to write."""
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+    cfg = _logistic_cfg(tmp_path, **{"optimizer.name": "lamb",
+                                     "optimizer.zero1": "on",
+                                     "optimizer.zero1_min_size": "8",
+                                     **kw})
+    tr = Trainer(cfg, mesh=create_mesh(MeshConfig(data=8)))
+    tr.init_state()
+    return cfg, tr
+
+
+def _train_steps(tr, n=2):
+    rng = np.random.RandomState(3)
+    batches = [{"images": rng.randn(16, 64).astype(np.float32),
+                "labels": rng.randint(0, 4, 16).astype(np.int32)}
+               for _ in range(n)]
+    state, _ = tr.train(iter(batches), num_steps=n)
+    return state
+
+
+def test_sharded_roundtrip_and_reshard(tmp_path):
+    """The sharded layout's acceptance arc in one test: an async sharded
+    save commits atomically (manifest covers the shard files), restores
+    bit-exact into the same topology, re-shards into a DIFFERENT device
+    count + replicated (zero1 off) layout, and an orbax-written
+    checkpoint still restores into a zero1 state — both layouts read
+    both."""
+    from distributed_resnet_tensorflow_tpu.checkpoint import shards
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+
+    cfg, tr = _zero1_trainer(tmp_path)
+    state = _train_steps(tr)
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=True,
+                             sharded="on")
+    mngr.save(2, state)
+    mngr.wait_until_finished()
+    assert mngr.latest_step() == 2
+    step_dir = os.path.join(cfg.checkpoint.directory, "2")
+    assert shards.is_sharded_layout(step_dir)
+    # the manifest covers the shard payload files like any other payload
+    from distributed_resnet_tensorflow_tpu.resilience.manifest import (
+        manifest_status)
+    status, _detail = manifest_status(step_dir)
+    assert status == "ok"
+
+    # same-topology restore: bit exact, optimizer state still sharded
+    _cfg2, tr2 = _zero1_trainer(tmp_path)
+    restored, step = mngr.restore(tr2.state)
+    assert step == 2 and int(restored.step) == 2
+    for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                    jax.tree_util.tree_leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    sharded_leaves = [l for l in
+                      jax.tree_util.tree_leaves(restored.opt_state)
+                      if hasattr(l, "sharding")
+                      and not l.sharding.is_fully_replicated]
+    assert sharded_leaves
+
+    # re-shard: restore into a 2-device replicated (zero1 off) trainer
+    cfg3 = _logistic_cfg(tmp_path, **{"optimizer.name": "lamb"})
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+    tr3 = Trainer(cfg3, mesh=create_mesh(MeshConfig(data=2),
+                                         devices=jax.devices()[:2]))
+    tr3.init_state()
+    reader = CheckpointManager(cfg3.checkpoint.directory, writer=False,
+                               async_save=False)
+    restored3, step3 = reader.restore(tr3.state)
+    assert step3 == 2
+    for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                    jax.tree_util.tree_leaves(restored3.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # cross-layout: an orbax save restores into the zero1 trainer
+    mngr_orbax = CheckpointManager(cfg.checkpoint.directory,
+                                   async_save=False, sharded="off")
+    mngr_orbax.save(5, state, force=True)
+    _cfg4, tr4 = _zero1_trainer(tmp_path)
+    restored4, step4 = mngr_orbax.restore(tr4.state)
+    assert step4 == 5
+    mngr.close()
+    mngr_orbax.close()
+    reader.close()
+
+
+def test_sharded_cross_host_count_restore(tmp_path):
+    """The re-shard path proper: the SAME state written as-if by TWO
+    hosts (its owned pieces split into two host files via the
+    checkpoint/shards.py API the multi-process writer uses) restores
+    bit-exact into a single-process trainer — and a single-host write
+    restores into a different-mesh (fsdp) state. The reader never learns
+    the writer count; it merges whatever host indexes exist."""
+    from distributed_resnet_tensorflow_tpu.checkpoint import shards
+    from distributed_resnet_tensorflow_tpu.checkpoint.manager import (
+        _saveable)
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+    from distributed_resnet_tensorflow_tpu.resilience.manifest import (
+        write_manifest)
+    from distributed_resnet_tensorflow_tpu.utils.config import MeshConfig
+
+    cfg, tr = _zero1_trainer(tmp_path)
+    state = _train_steps(tr)
+    parts = shards.host_snapshot_parts(_saveable(state))
+    assert parts.owned, "zero1 state produced no sharded pieces"
+
+    # split every sharded leaf's pieces across two synthetic hosts, as a
+    # 2-process run would (each host owns a disjoint subset)
+    def half(parts_owned, which):
+        out = []
+        for key, comps, gshape, dtype, pieces in parts_owned:
+            mine = [p for i, p in enumerate(pieces) if i % 2 == which]
+            if mine:
+                out.append((key, comps, gshape, dtype, mine))
+        return out
+
+    staging = os.path.join(str(tmp_path), "ckpt2", "_staging.7")
+    final = os.path.join(str(tmp_path), "ckpt2", "7")
+    os.makedirs(os.path.dirname(final), exist_ok=True)
+    shards.write_host_shards(
+        staging, 0, shards.SnapshotParts(parts.base, half(parts.owned, 0)))
+    shards.write_host_shards(
+        staging, 1, shards.SnapshotParts([], half(parts.owned, 1)))
+    write_manifest(staging, 7)
+    os.replace(staging, final)
+
+    # restore at ONE process (8-device zero1 target)
+    _cfg2, tr2 = _zero1_trainer(tmp_path)
+    reader = CheckpointManager(os.path.dirname(final), writer=False,
+                               async_save=False)
+    restored, step = reader.restore(tr2.state)
+    assert step == 7
+    for a, b in zip(jax.tree_util.tree_leaves(_saveable(state)),
+                    jax.tree_util.tree_leaves(_saveable(restored))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # ...and the single-host write restores into an fsdp mesh (different
+    # shard geometry than it was written from)
+    cfg3 = _logistic_cfg(tmp_path, **{"optimizer.name": "lamb",
+                                      "optimizer.zero1": "on",
+                                      "optimizer.zero1_min_size": "8"})
+    tr3 = Trainer(cfg3, mesh=create_mesh(MeshConfig(data=4, fsdp=2)))
+    tr3.init_state()
+    restored3, step3 = reader.restore(tr3.state)
+    assert step3 == 7
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored3.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    reader.close()
+
+
+def test_sharded_torn_staging_invisible_and_swept(tmp_path):
+    """A staged-but-uncommitted sharded save is invisible to every
+    committed-step reader and swept by the next writer-side manager."""
+    from distributed_resnet_tensorflow_tpu.checkpoint import shards
+    from distributed_resnet_tensorflow_tpu.checkpoint.manager import (
+        _saveable)
+    from distributed_resnet_tensorflow_tpu.resilience.manifest import (
+        committed_steps, is_staging_name)
+
+    cfg, tr = _zero1_trainer(tmp_path)
+    state = _train_steps(tr)
+    parts = shards.host_snapshot_parts(_saveable(state))
+    staging = os.path.join(cfg.checkpoint.directory, "_staging.9")
+    os.makedirs(cfg.checkpoint.directory, exist_ok=True)
+    shards.write_host_shards(staging, 0, parts)
+    shards.write_done_marker(staging, 0)  # staged, never committed
+    assert committed_steps(cfg.checkpoint.directory) == []
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False)
+    assert not [n for n in os.listdir(cfg.checkpoint.directory)
+                if is_staging_name(n)]
+    restored, step = mngr.restore(tr.state)
+    assert step is None
+    mngr.close()
+
+
+def test_shard_reader_torn_set_raises(tmp_path):
+    """A shard set with a missing host file (incomplete coverage) must
+    fail the assemble loudly — restore then falls back to an older
+    committed step instead of silently zero-filling optimizer state."""
+    from distributed_resnet_tensorflow_tpu.checkpoint import shards
+    from distributed_resnet_tensorflow_tpu.checkpoint.manager import (
+        _saveable)
+
+    cfg, tr = _zero1_trainer(tmp_path)
+    state = _train_steps(tr)
+    parts = shards.host_snapshot_parts(_saveable(state))
+    key0, comps0, gshape0, dtype0, pieces0 = parts.owned[0]
+    assert len(pieces0) > 1
+    step_dir = os.path.join(str(tmp_path), "torn", "11")
+    shards.write_host_shards(
+        step_dir, 0,
+        shards.SnapshotParts(parts.base, [
+            (key0, comps0, gshape0, dtype0, pieces0[:1])]))  # half a leaf
+    with shards.ShardReader(step_dir) as reader:
+        with pytest.raises(ValueError, match="torn shard set"):
+            reader.assemble(key0)
+
+
+def test_sharded_swap_subtree_read(tmp_path):
+    """The serving hot-swap's read path: params/batch_stats/step rebuild
+    as host numpy straight from the shard indexes (serve/swap.py uses
+    exactly this on a sharded checkpoint)."""
+    from distributed_resnet_tensorflow_tpu.checkpoint import shards
+
+    cfg, tr = _zero1_trainer(tmp_path)
+    state = _train_steps(tr)
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False,
+                             sharded="on")
+    mngr.save(2, state)
+    mngr.wait_until_finished()
+    step_dir = os.path.join(cfg.checkpoint.directory, "2")
+    with shards.ShardReader(step_dir) as reader:
+        assert int(np.asarray(reader.read_subtree("step"))) == 2
+        params = reader.read_subtree("params")
+    flat_live = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    for path, leaf in flat_live:
+        cur = params
+        for p in path:
+            cur = cur[p.key]
+        np.testing.assert_array_equal(cur, np.asarray(leaf))
+    mngr.close()
+
+
+def test_ckpt_shard_event_row(tmp_path):
+    """Per-host shard accounting rides ckpt_async_stats into the
+    registered ckpt_shard event row; a second cadence with no new bytes
+    writes nothing."""
+    from distributed_resnet_tensorflow_tpu.train.hooks import CkptShardHook
+    from distributed_resnet_tensorflow_tpu.utils.metrics import (
+        MetricsWriter, ckpt_async_stats, read_metrics)
+
+    cfg, tr = _zero1_trainer(tmp_path)
+    state = _train_steps(tr)
+    ckpt_async_stats.reset()
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=True,
+                             sharded="on")
+    mngr.save(2, state)
+    mngr.wait_until_finished()
+    snap = ckpt_async_stats.snapshot()
+    assert snap["shard_bytes"] > 0 and snap["shard_files"] >= 2
+    w = MetricsWriter(str(tmp_path / "m"), enable_tensorboard=False)
+    hook = CkptShardHook(w, every_steps=1)
+    hook(1, state, {})
+    hook(2, state, {})  # nothing advanced — no second row
+    w.close()
+    rows = [r for r in read_metrics(str(tmp_path / "m"))
+            if r.get("event") == "ckpt_shard"]
+    assert len(rows) == 1
+    assert rows[0]["shard_bytes"] == snap["shard_bytes"]
+    assert rows[0]["process"] == 0
+    mngr.close()
+
+
+_SHARDED_KILL_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
+    force_cpu_platform)
+force_cpu_platform()
+from distributed_resnet_tensorflow_tpu.utils.config import (get_preset,
+                                                            MeshConfig)
+from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+from distributed_resnet_tensorflow_tpu.train import Trainer
+from distributed_resnet_tensorflow_tpu.checkpoint import CheckpointManager
+from distributed_resnet_tensorflow_tpu.resilience import faultinject
+
+cfg = get_preset("smoke")
+cfg.model.name = "logistic"
+cfg.model.input_size = 64
+cfg.model.hidden_units = 32
+cfg.model.num_classes = 4
+cfg.optimizer.name = "lamb"
+cfg.optimizer.zero1 = "on"
+cfg.optimizer.zero1_min_size = 8
+tr = Trainer(cfg, mesh=create_mesh(MeshConfig(data=8)))
+tr.init_state()
+ckpt_dir = sys.argv[1]
+marker = sys.argv[2]
+m = CheckpointManager(ckpt_dir, async_save=True, sharded="on")
+m.save(1, tr.state.replace(step=tr.state.step + 1), force=True)
+m.wait_until_finished()
+print("STEP1_COMMITTED", flush=True)
+# arm the commit-window nap ONLY for the step-2 save (it sits between the
+# shard-marker finalize wait and the manifest+rename), hand it to the
+# writer thread, and report readiness — the parent SIGKILLs us inside the
+# nap with every shard file staged but nothing committed
+os.environ[faultinject.CKPT_COMMIT_SLEEP_ENV_VAR] = "60"
+os.environ[faultinject.CKPT_COMMIT_MARKER_ENV_VAR] = marker
+m.save(2, tr.state.replace(step=tr.state.step + 2), force=True)
+m.wait_until_finished()
+print("UNREACHABLE", flush=True)
+"""
+
+
+@pytest.mark.slow  # subprocess + jax import; runs in the full suite and chaos_smoke.sh
+def test_kill_during_sharded_commit_restores_committed_step(tmp_path):
+    """Crash consistency for the SHARDED layout: SIGKILL while the writer
+    sits between staging its per-host shard files (markers down) and the
+    manifest+commit rename. The torn staging dir — shard files and all —
+    must never read as a checkpoint, the next manager sweeps it, and
+    restore lands on the newest committed step across all hosts."""
+    import signal
+    import subprocess
+    import sys as _sys
+    from distributed_resnet_tensorflow_tpu.resilience.manifest import (
+        committed_steps, is_staging_name)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt_dir = str(tmp_path / "ckpt")
+    marker = str(tmp_path / "marker")
+    child = subprocess.Popen(
+        [_sys.executable, "-c", _SHARDED_KILL_CHILD.format(repo=repo),
+         ckpt_dir, marker],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        deadline = time.monotonic() + 180
+        while not os.path.exists(marker):
+            assert time.monotonic() < deadline, \
+                "writer never reached the commit window"
+            assert child.poll() is None, "child died early"
+            time.sleep(0.05)
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    assert committed_steps(ckpt_dir) == [1]
+    staging = [n for n in os.listdir(ckpt_dir) if is_staging_name(n)]
+    assert staging, "expected the torn staging dir to survive the kill"
+    # fresh writer-side manager sweeps it; restore lands on step 1
+    cfg, tr = _zero1_trainer(tmp_path)
+    mngr = CheckpointManager(ckpt_dir, async_save=False)
+    assert not [n for n in os.listdir(ckpt_dir) if is_staging_name(n)]
+    restored, step = mngr.restore(tr.state)
+    assert step == 1 and int(restored.step) == 1
+    mngr.close()

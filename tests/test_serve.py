@@ -401,3 +401,42 @@ def test_threaded_swap_and_sanitizer_clean(tmp_path):
     assert server.batcher.errors == 0
     assert server.dropped == 0
     assert server.cache.serve_time_compiles == 0
+
+
+@pytest.mark.heavy
+def test_hot_swap_reads_sharded_checkpoint(tmp_path):
+    """A trainer running per-host SHARDED checkpoints (checkpoint.sharded,
+    checkpoint/shards.py) publishes a layout the serving hot-swap must
+    read: the swapper rebuilds step/params/batch_stats from the shard
+    indexes (never opening the optimizer shards) and applies it like any
+    orbax checkpoint."""
+    cfg = _tiny_cfg(tmp_path)
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    img = _images(1)[0]
+
+    # commit the server's params rescaled, via the SHARDED writer
+    mngr = CheckpointManager(cfg.checkpoint.directory, async_save=False,
+                             max_to_keep=100, sharded="on")
+    st = server.trainer.state
+    host = lambda x: np.asarray(x)  # noqa: E731
+    st = st.replace(step=np.asarray(9, np.int32),
+                    params=jax.tree_util.tree_map(
+                        lambda x: host(x) * 0.5, st.params),
+                    batch_stats=jax.tree_util.tree_map(host, st.batch_stats),
+                    opt_state=jax.tree_util.tree_map(host, st.opt_state))
+    mngr.save(9, st, force=True)
+    mngr.close()
+    from distributed_resnet_tensorflow_tpu.checkpoint import shards
+    assert shards.is_sharded_layout(
+        os.path.join(cfg.checkpoint.directory, "9"))
+
+    pending = server.swapper.poll_once()
+    assert pending is not None and pending.step == 9
+    f = server.submit(img)
+    server.service_once()
+    f.result(timeout=5)
+    server.service_once()
+    assert server.serving_step == 9
+    server.close()
+    assert server.dropped == 0
