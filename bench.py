@@ -961,6 +961,96 @@ def _zero1_ab(n_steps: int = 20):
     return rows
 
 
+def bench_precision(budget_left):
+    """The low-precision row (ISSUE 12; docs/precision.md): steps/s AND
+    exchanged bucket bytes for f32 vs bf16 vs bf16+compressed-exchange
+    on a multi-device mesh — in-process when this backend has >1 device,
+    else the --overlap-ab subprocess pattern (virtual 8-device CPU mesh:
+    the byte accounting is layout-true everywhere; the bf16 step-time
+    story needs real MXUs, which is why the CPU rows are structure
+    checks, not speedups)."""
+    if budget_left() < 60:
+        return {"skipped": "over bench budget"}
+    try:
+        if len(jax.devices()) > 1:
+            return _precision_ab()
+        import subprocess
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--precision-ab"],
+            capture_output=True, text=True, env=env,
+            timeout=max(60, budget_left()))
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-300:])
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        out["virtual_devices"] = 8
+        return out
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _precision_ab(n_steps: int = 20):
+    """train.precision / comm.compress A/B on THIS backend's devices,
+    all three rows over the SAME bucketed exchange (comm.overlap=on,
+    one bucket plan) so the per-bucket byte columns compare like for
+    like: f32 (the oracle), bf16 step (f32 wire), bf16 step + bf16 wire
+    (the arXiv:1811.05233 recipe). The plan's grad_bytes/wire_bytes pair
+    IS the acceptance claim: same buckets, half the exchanged bytes."""
+    from distributed_resnet_tensorflow_tpu.parallel.overlap import (
+        overlap_stats)
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import (
+        shard_batch)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+
+    rng = np.random.RandomState(0)
+    bs = 64
+    images = rng.randn(bs, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, (bs,)).astype(np.int32)
+    rows = {}
+    for label, precision, compress in (("f32", "off", "off"),
+                                       ("bf16", "bf16", "off"),
+                                       ("bf16_compress", "bf16", "bf16")):
+        cfg = get_preset("cifar10_resnet50")
+        cfg.model.resnet_size = 8
+        cfg.model.compute_dtype = "float32"  # the policy is the knob
+        cfg.train.batch_size = bs
+        cfg.train.precision = precision
+        cfg.comm.overlap = "on"
+        cfg.comm.bucket_mb = 0.25
+        cfg.comm.compress = compress
+        cfg.mesh.data = len(jax.devices())
+        overlap_stats.reset()
+        trainer = Trainer(cfg)
+        trainer.init_state()
+        step_fn = trainer.jitted_train_step()
+        batch = shard_batch({"images": images, "labels": labels},
+                            trainer.mesh)
+        state = trainer.state
+        for _ in range(3):  # compile + warm
+            state, _m = step_fn(state, batch)
+        jax.block_until_ready(state.params)
+        state, dt = _best_time(step_fn, state, [batch], n_steps, reps=3)
+        plan = overlap_stats.snapshot() or {}
+        rows[label] = {"steps_per_sec": round(n_steps / dt, 2),
+                       "step_ms": round(dt / n_steps * 1000, 2),
+                       "grad_bytes": plan.get("grad_bytes"),
+                       "wire_bytes": plan.get("wire_bytes"),
+                       "buckets": plan.get("buckets"),
+                       "bucket_wire_bytes": plan.get("bucket_wire_bytes")}
+    rows["bf16_vs_f32_steps"] = round(
+        rows["bf16"]["steps_per_sec"] / rows["f32"]["steps_per_sec"], 3)
+    rows["compress_wire_ratio"] = round(
+        rows["bf16_compress"]["wire_bytes"] /
+        max(rows["f32"]["wire_bytes"], 1), 3)
+    rows["same_bucket_plan"] = \
+        rows["bf16_compress"]["buckets"] == rows["f32"]["buckets"]
+    return rows
+
+
 def bench_serving(budget_left):
     """The serving row (serve/; docs/serving.md): open-loop synthetic load
     against the AOT-compiled batched inference server — p50/p99 request
@@ -976,21 +1066,38 @@ def bench_serving(budget_left):
     cfg.data.eval_batch_size = 64          # buckets: pad, 2x, ... 64
     cfg.mesh.data = len(jax.devices())
     cfg.serve.max_queue_delay_ms = 2.0
+    # (batch, variant) buckets (docs/precision.md): the same replica
+    # carries the f32 oracle AND a bf16 weight/compute variant; the row
+    # drives one open loop per variant so p50/p99/QPS read per dtype
+    cfg.serve.variants = ("f32", "bf16")
     cfg.checkpoint.directory = os.path.join(
         tempfile.gettempdir(), "drt_bench_serve_empty_ckpt")  # no ckpt:
     # serving fresh-init params — the row times the serving path, not
     # training; hot-swap cost is covered by tests/serve_smoke.sh
     server = InferenceServer(cfg)
+    by_variant = {}
     try:
         server.start()
-        duration = min(8.0, max(3.0, budget_left() - 30))
-        load = run_open_loop(server, qps=50.0, duration_secs=duration,
-                             seed=0)
+        duration = min(8.0, max(3.0, (budget_left() - 30) /
+                                len(server.variants)))
+        for variant in server.variants:
+            t0 = time.perf_counter()
+            done_before = server.completed
+            load = run_open_loop(server, qps=50.0, duration_secs=duration,
+                                 seed=0, variant=variant)
+            wall = time.perf_counter() - t0
+            by_variant[variant] = {
+                "offered_qps": load["offered_qps"],
+                "achieved_qps": round(
+                    (server.completed - done_before) / max(wall, 1e-9), 1),
+                "failed": load.get("failed", 0),
+            }
     finally:
         server.close()
     rep = server.report()
     return {
-        "offered_qps": load["offered_qps"],
+        "variants": rep["variants"],
+        "by_variant": by_variant,
         "achieved_qps": rep["qps"],
         "dropped": rep["dropped"],
         "batches": rep["batches"],
@@ -1068,6 +1175,10 @@ def main():
         # bench_zero1's multi-device re-entry (same contract)
         print(json.dumps(_zero1_ab()))
         return
+    if "--precision-ab" in sys.argv:
+        # bench_precision's multi-device re-entry (same contract)
+        print(json.dumps(_precision_ab()))
+        return
     t0 = time.monotonic()
     try:
         budget = float(os.environ.get("BENCH_BUDGET_SECS", "900"))
@@ -1112,6 +1223,9 @@ def main():
                     # optimizer bytes + steps/s, dp vs dp+ZeRO-1, with the
                     # reduce-scatter/all-gather payload plan
                     ("zero1", lambda: bench_zero1(budget_left)),
+                    # low-precision hot paths (ISSUE 12): bf16 step +
+                    # compressed exchange A/B with per-bucket wire bytes
+                    ("precision", lambda: bench_precision(budget_left)),
                     ("imagenet_norm_contracts",
                      lambda: bench_imagenet_norm(budget_left))):
         if time.monotonic() - t0 > budget:

@@ -151,7 +151,8 @@ def check_spec_tree(state_shapes, shardings, mesh,
 def elaborate_config(cfg, mesh_cfg, locus: str,
                      trace_steps: bool = True,
                      trace_forward: bool = True,
-                     _state_cache: Optional[dict] = None) -> List[Finding]:
+                     _state_cache: Optional[dict] = None,
+                     _precision_seen: Optional[set] = None) -> List[Finding]:
     """Elaborate ONE (config, mesh layout): returns findings (empty=clean).
 
     ``trace_steps=False`` skips the train/eval-step traces (the expensive
@@ -300,6 +301,67 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
         except Exception as e:
             findings.append(_findings_from_exc("elab-overlap-step", locus,
                                                "bucketed overlap step", e))
+
+        # bf16 precision-policy step (parallel/precision.py): the
+        # train.precision=bf16 variant of this preset × layout, traced
+        # abstractly over the SAME f32 master state shapes (the policy's
+        # whole contract) — a policy cast that breaks a shard_map spec,
+        # a model family that can't take the dtype override, or a
+        # fused-kernel dtype mismatch is a gate finding here, not a
+        # step-1 crash when an operator first flips the knob. Presets
+        # that already pin precision=bf16 were traced above; the
+        # compressed-exchange composition rides the overlap envelope.
+        try:
+            import copy
+            import dataclasses as _dc
+            from ..parallel.overlap import overlap_unsupported_reason
+            # dedupe across presets sharing the identical
+            # (model, data, optimizer) triple — the schedule/batch
+            # variants of one base preset would re-trace the same bf16
+            # program (the trace_forward lesson from round 11). Batch
+            # size is deliberately NOT in the key: this trace hunts
+            # DTYPE bugs, which are batch-independent; divisibility is
+            # the main elab-train-step trace's job, per preset.
+            pkey = repr((_dc.asdict(cfg.model), cfg.data.dataset,
+                         cfg.data.image_size, cfg.optimizer.name))
+            seen = _precision_seen if _precision_seen is not None \
+                else set()
+            if cfg.train.precision == "off" and pkey not in seen:
+                seen.add(pkey)
+                pcfg = copy.deepcopy(cfg)
+                pcfg.train.precision = "bf16"
+                ptrainer = Trainer(pcfg, mesh=mesh)
+                batch = _abstract_batch(pcfg, pcfg.train.batch_size)
+                jax.eval_shape(ptrainer._train_step, state_shapes, batch)
+                if trace_forward:
+                    # the serving bf16 VARIANT forward, one bucket is
+                    # enough (the dtype path is bucket-independent) —
+                    # traced over the CAST abstract state, exactly what
+                    # ServeCompileCache compiles the variant against
+                    from ..parallel.precision import (
+                        SERVE_VARIANT_DTYPES, make_variant_cast)
+                    vstep = ptrainer.make_variant_predict_step(
+                        SERVE_VARIANT_DTYPES["bf16"])
+                    vstate = jax.eval_shape(make_variant_cast("bf16"),
+                                            state_shapes)
+                    pad_to = ptrainer.eval_pad_multiple()
+                    from ..serve.server import serve_image_spec
+                    vshape, vdtype = serve_image_spec(pcfg)
+                    vbatch = {"images": jax.ShapeDtypeStruct(
+                        (pad_to,) + vshape, vdtype)}
+                    jax.eval_shape(vstep, vstate, vbatch)
+                if overlap_unsupported_reason(pcfg, mesh) is None:
+                    # bf16 step × bucketed exchange × compressed payload
+                    # — the full low-precision composition
+                    ccfg = copy.deepcopy(pcfg)
+                    ccfg.comm.overlap = "on"
+                    ccfg.comm.compress = "bf16"
+                    ctrainer = Trainer(ccfg, mesh=mesh)
+                    jax.eval_shape(ctrainer._train_step, state_shapes,
+                                   batch)
+        except Exception as e:
+            findings.append(_findings_from_exc(
+                "elab-precision-step", locus, "bf16 precision step", e))
 
         # coalesced staged-unpack program (parallel/sharding._build_unpack)
         # — and, for imagenet presets, the FUSED on-device augmentation
@@ -513,6 +575,7 @@ def run_elaborate(preset_names: Optional[Sequence[str]] = None,
             "initializes")]
     import dataclasses
     seen_forward: set = set()
+    precision_seen: set = set()  # bf16-trace dedupe across presets
     for name in (preset_names or sorted(PRESETS)):
         cfg = get_preset(name)
         state_cache: dict = {}
@@ -539,6 +602,7 @@ def run_elaborate(preset_names: Optional[Sequence[str]] = None,
                 elaborate_config(cfg, mesh_cfg, f"{name}@{label}",
                                  trace_steps=trace,
                                  trace_forward=trace and fwd,
-                                 _state_cache=state_cache))
+                                 _state_cache=state_cache,
+                                 _precision_seen=precision_seen))
             traced = True
     return findings

@@ -8,7 +8,7 @@ known-bad fixture to tests/test_analysis.py and a row to the catalog in
 docs/static_analysis.md.
 """
 from . import (bare_assert, cached_mesh, ckpt_io, device_put, exit_codes,
-               opt_state, registry_drift)
+               opt_state, precision_cast, registry_drift)
 
 ALL_RULES = (
     device_put,
@@ -18,4 +18,5 @@ ALL_RULES = (
     registry_drift,
     ckpt_io,
     opt_state,
+    precision_cast,
 )

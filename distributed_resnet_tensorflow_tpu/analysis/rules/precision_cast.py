@@ -1,0 +1,125 @@
+"""unpolicied-matmul: models/ops matmuls must go through the policy cast.
+
+The mixed-precision policy (parallel/precision.py; docs/precision.md)
+only delivers its MFU win if every matmul/conv on the hot path actually
+computes in the policy dtype. The cast is threaded ONE way: flax modules
+take an explicit ``dtype=`` (the policy dtype, or a deliberate
+``jnp.float32`` for f32 islands like logit heads and BN affine math);
+raw contractions show their dtype choice at the call site (an
+``.astype(...)`` on an operand, or ``preferred_element_type=`` pinning
+the accumulator). A call site with NEITHER silently computes in flax's
+promoted default — f32 — and the policy quietly loses that op: the MFU
+gap this rule exists to catch never shows up as an error, only as a
+step-time plateau someone has to re-profile months later.
+
+The rule flags, in ``models/`` and ``ops/`` package code:
+
+  * ``nn.Dense`` / ``nn.DenseGeneral`` / ``nn.Conv`` / ``nn.ConvLocal``
+    / ``nn.ConvTranspose`` / ``nn.Einsum`` calls without a ``dtype=``
+    keyword (flax's ``dtype=None`` promotes to the f32 param dtype —
+    bypassing the policy);
+  * ``jnp.dot`` / ``jnp.matmul`` / ``jnp.einsum`` /
+    ``lax.dot_general`` / ``lax.conv_general_dilated`` calls whose
+    source (call segment or its first line) shows neither an
+    ``.astype(`` cast nor a ``preferred_element_type=`` argument.
+
+Deliberate f32 call sites stay deliberate: pass ``dtype=jnp.float32``
+(preferred — the dtype IS the documentation) or suppress with
+``# shardcheck: ok(unpolicied-matmul)``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from ..report import Finding
+
+RULE_NAME = "unpolicied-matmul"
+DOC = __doc__
+
+#: flax module constructors whose ``dtype=`` kwarg IS the policy cast
+_FLAX_CTORS = {"Dense", "DenseGeneral", "Conv", "ConvLocal",
+               "ConvTranspose", "Einsum"}
+
+#: raw contraction entry points that must show their dtype choice
+_RAW_CONTRACTIONS = {"dot", "matmul", "einsum", "dot_general",
+                     "conv_general_dilated"}
+
+#: package subtrees on the model/op hot path (serve/train loops reuse
+#: these — a stray f32 matmul anywhere else is not a *model* FLOP)
+_SCOPES = ("models", "ops")
+
+
+def _in_scope(rel: str) -> bool:
+    parts = rel.replace(os.sep, "/").split("/")
+    return any(scope in parts[:-1] for scope in _SCOPES)
+
+
+def _attr_chain(node: ast.AST):
+    """Dotted name of a call target: Attribute chains flattened
+    ("jax.lax.dot_general" → ["jax", "lax", "dot_general"])."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _call_text(sf, call: ast.Call) -> str:
+    """The call's source segment (multi-line args included), falling back
+    to its first physical line."""
+    seg = ast.get_source_segment(sf.text, call)
+    if seg:
+        return seg
+    lines = sf.lines
+    return lines[call.lineno - 1] if 0 < call.lineno <= len(lines) else ""
+
+
+def check(ctx) -> Iterable[Finding]:
+    for sf in ctx.package_py:
+        if not _in_scope(sf.rel) or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            leaf = chain[-1]
+            # flax module ctor: nn.Dense(...) / linen.Conv(...)
+            if leaf in _FLAX_CTORS and len(chain) >= 2:
+                if not _has_kwarg(node, "dtype"):
+                    yield Finding(
+                        RULE_NAME, sf.rel, node.lineno,
+                        f"{'.'.join(chain)}(...) without an explicit "
+                        "dtype= computes in flax's promoted f32 default, "
+                        "bypassing the precision policy "
+                        "(parallel/precision.py) — pass the policy dtype "
+                        "(or a deliberate jnp.float32)")
+                continue
+            if leaf in _RAW_CONTRACTIONS and len(chain) >= 2 and \
+                    chain[0] in ("jnp", "jax", "lax", "np"):
+                if chain[0] == "np":
+                    continue  # host-side numpy math is not a device matmul
+                text = _call_text(sf, node)
+                # the surrounding line too: `einsum(...).astype(f32)`
+                # casts the RESULT — still a visible dtype decision
+                line = sf.lines[node.lineno - 1] \
+                    if 0 < node.lineno <= len(sf.lines) else ""
+                if ".astype(" in text or ".astype(" in line or \
+                        "preferred_element_type" in text:
+                    continue
+                yield Finding(
+                    RULE_NAME, sf.rel, node.lineno,
+                    f"{'.'.join(chain)}(...) shows no dtype decision "
+                    "(no operand .astype(...), no "
+                    "preferred_element_type=) — the contraction silently "
+                    "computes in the promoted input dtype, bypassing the "
+                    "precision policy (parallel/precision.py)")

@@ -39,9 +39,10 @@ from .resilience.sentinel import train_with_nan_recovery
 from .telemetry import configure_from_config as _configure_telemetry
 from .telemetry.tracer import recorder as _flight_recorder
 from .train.hooks import (CheckpointHook, CkptAsyncHook, CkptShardHook,
-                          CommOverlapHook, CorruptRecordsHook, GoodputHook,
-                          HeartbeatHook, InputEchoHook, InputStagesHook,
-                          LoggingHook, NanGuardHook, SummaryHook,
+                          CommCompressHook, CommOverlapHook,
+                          CorruptRecordsHook, GoodputHook, HeartbeatHook,
+                          InputEchoHook, InputStagesHook, LoggingHook,
+                          NanGuardHook, PrecisionHook, SummaryHook,
                           Zero1Hook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
@@ -415,6 +416,16 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         # row per resolved plan; silent when optimizer.zero1 resolved off
         if trainer.zero1_active:
             hooks.append(Zero1Hook(writer, cfg.train.summary_every_steps))
+        # per-run precision/compression summary (parallel/precision.py) —
+        # one row per resolved policy; silent when everything runs f32
+        if trainer.precision_active or trainer.comm_compress_active:
+            hooks.append(PrecisionHook(writer,
+                                       cfg.train.summary_every_steps))
+        # compressed-exchange payload accounting — one row per traced
+        # plan when comm.compress actually narrowed the wire
+        if trainer.comm_compress_active:
+            hooks.append(CommCompressHook(writer,
+                                          cfg.train.summary_every_steps))
     # per-host sharded-checkpoint accounting: EVERY process exports its
     # own ckpt_shard rows (each host stages only its shard — the chief's
     # stream alone would claim 1/N of the cluster's bytes). Non-chief
@@ -678,6 +689,12 @@ def run_train_and_eval(cfg: ExperimentConfig):
             if trainer.zero1_active:
                 hooks.append(Zero1Hook(writer,
                                        cfg.train.summary_every_steps))
+            if trainer.precision_active or trainer.comm_compress_active:
+                hooks.append(PrecisionHook(
+                    writer, cfg.train.summary_every_steps))
+            if trainer.comm_compress_active:
+                hooks.append(CommCompressHook(
+                    writer, cfg.train.summary_every_steps))
     # per-host sharded-ckpt accounting: every process exports, like
     # run_train (the monitor's per-host rollup reads these)
     te_shard_writer = None

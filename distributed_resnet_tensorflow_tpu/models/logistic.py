@@ -4,8 +4,16 @@ Parity with reference logist_model.py (LRNet: flattened image → dense(hidden)
 → ReLU → dense(classes), reference logist_model.py:14-58). Used to debug the
 distribution layer without conv cost, like the reference's commented swap at
 resnet_cifar_main.py:257.
+
+``dtype`` is the compute dtype (the precision-policy hook,
+parallel/precision.py); it defaults to f32 — the toy's historical
+behavior — and is only narrowed by an explicit policy/variant override
+through ``models.create_model``. Params stay f32 masters (flax
+param_dtype default) and the logits leave f32 like every model family.
 """
 from __future__ import annotations
+
+from typing import Any
 
 import flax.linen as nn
 import jax
@@ -15,11 +23,14 @@ import jax.numpy as jnp
 class LogisticNet(nn.Module):
     num_classes: int = 10
     hidden_units: int = 100
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
         del train
-        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
-        x = nn.Dense(self.hidden_units)(x)
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.Dense(self.hidden_units, dtype=self.dtype)(x)
         x = nn.relu(x)
-        return nn.Dense(self.num_classes)(x)
+        # f32 head: logits always leave full-precision (the model-family
+        # contract the CE/metrics path relies on)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -422,14 +422,26 @@ class ImageNetResNetV2(nn.Module):
 
 def create_model(model_cfg, dataset: str, axis_name: Optional[str] = None,
                  remat: bool = False, bn_groups: int = 1,
-                 mesh=None) -> nn.Module:
+                 mesh=None, compute_dtype=None) -> nn.Module:
     """Model factory; replaces the dataset dispatch in reference
-    resnet_model.py:69-76 (which hard-coded resnet_size=50 for both)."""
-    dtype = jnp.dtype(model_cfg.compute_dtype)
+    resnet_model.py:69-76 (which hard-coded resnet_size=50 for both).
+
+    ``compute_dtype`` overrides ``model_cfg.compute_dtype`` — the
+    mixed-precision policy's hook (parallel/precision.py: the Trainer
+    passes the policy dtype; the serving variant builder passes the
+    variant dtype). None keeps the legacy per-family contract, including
+    the logistic toy's pinned-f32 compute."""
+    dtype = jnp.dtype(compute_dtype) if compute_dtype is not None \
+        else jnp.dtype(model_cfg.compute_dtype)
     if model_cfg.name == "logistic":
         from .logistic import LogisticNet
+        # the toy MLP historically ignored compute_dtype (f32 always);
+        # only an explicit policy/variant override changes its compute —
+        # the legacy path must stay bit-identical
         return LogisticNet(num_classes=model_cfg.num_classes,
-                           hidden_units=model_cfg.hidden_units)
+                           hidden_units=model_cfg.hidden_units,
+                           dtype=dtype if compute_dtype is not None
+                           else jnp.float32)
     if model_cfg.name == "vit":
         from .transformer import VisionTransformer
         attn = model_cfg.attention_impl

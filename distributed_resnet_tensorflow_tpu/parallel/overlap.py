@@ -29,6 +29,17 @@ tests/test_overlap.py on the virtual 8-device mesh. Against the default
 XLA-propagation path the result agrees to float rounding (the reduction
 tree differs), not bitwise.
 
+Compressed exchange (``comm.compress``, docs/precision.md): each bucket's
+payload is cast to bf16/fp16 BEFORE its collective and re-materialized
+f32 after — half the inter-host bytes on the SAME bucket plan
+(arXiv:1811.05233 trained ImageNet/ResNet-50 to reference accuracy with
+half-precision allreduce). The cast is per-leaf and bucketing-independent,
+so the bit-identical many-vs-one-bucket claim HOLDS under compression
+(pinned by tests/test_precision.py); against the uncompressed exchange the
+result is allclose at the compressed dtype's rounding, by design. Local
+gradient accumulation and the optimizer update stay f32 — only the wire
+format narrows.
+
 Support envelope (``overlap_unsupported_reason``): batch-parallel meshes
 only (no pipeline/tensor/expert/seq axes — those bake their own
 shard_maps into the model), the conv/logistic families (the dp
@@ -59,11 +70,36 @@ log = logging.getLogger(__name__)
 BATCH_AXES = ("data", "fsdp")
 
 
+#: dtypes the exchange payload may compress to (``comm.compress``) — the
+#: SAME name→dtype map the step policy uses (parallel/precision.py is
+#: the one resolution point for every low-precision knob)
+from .precision import POLICY_DTYPES as COMPRESS_DTYPES  # noqa: E402
+
+
+def compress_dtype(cfg) -> Optional[str]:
+    """``comm.compress`` → the payload dtype NAME ("bf16"/"fp16") or None
+    (off). Pure validation — whether compression actually applies is the
+    overlap plan's call (it rides the bucketed exchange; the Trainer
+    warns when compression is requested while the exchange is off)."""
+    mode = cfg.comm.compress
+    if mode == "off":
+        return None
+    if mode not in COMPRESS_DTYPES:
+        raise ValueError(f"unknown comm.compress setting {mode!r}; "
+                         f"supported: off, {sorted(COMPRESS_DTYPES)}")
+    return mode
+
+
 @dataclass(frozen=True)
 class OverlapPlan:
-    """Resolved overlap configuration for one (cfg, mesh)."""
+    """Resolved overlap configuration for one (cfg, mesh).
+
+    ``compress`` names the exchange payload dtype ("bf16"/"fp16") or None
+    — carried on the plan because the gather leg (make_bucketed_gather)
+    and the exchange must agree, and both already receive the plan."""
 
     bucket_bytes: int
+    compress: Optional[str] = None
 
 
 class OverlapStats:
@@ -78,7 +114,8 @@ class OverlapStats:
 
     def record(self, bucket_bytes: int, bucket_sizes: Sequence[int],
                bucket_leaves: Sequence[int], total_bytes: int,
-               n_leaves: int) -> None:
+               n_leaves: int, compress: Optional[str] = None,
+               wire_bytes: Optional[Sequence[int]] = None) -> None:
         with self._lock:
             self._plan = {
                 "buckets": len(bucket_sizes),
@@ -87,6 +124,15 @@ class OverlapStats:
                 "bucket_leaves": [int(n) for n in bucket_leaves],
                 "grad_bytes": int(total_bytes),
                 "leaves": int(n_leaves),
+                # compressed-exchange payload accounting (comm.compress):
+                # the SAME bucket plan, narrower wire format — what the
+                # comm_compress metrics row and bench's precision row read
+                "compress": compress or "off",
+                "bucket_wire_bytes": [int(b) for b in wire_bytes]
+                if wire_bytes is not None
+                else [int(b) for b in bucket_sizes],
+                "wire_bytes": int(sum(wire_bytes)) if wire_bytes is not None
+                else int(total_bytes),
             }
 
     def reset(self) -> None:
@@ -167,7 +213,8 @@ def resolve_overlap(cfg, mesh: Mesh) -> Optional[OverlapPlan]:
     if cfg.comm.bucket_mb <= 0:
         raise ValueError(
             f"comm.bucket_mb must be > 0, got {cfg.comm.bucket_mb}")
-    return OverlapPlan(bucket_bytes=int(cfg.comm.bucket_mb * 2 ** 20))
+    return OverlapPlan(bucket_bytes=int(cfg.comm.bucket_mb * 2 ** 20),
+                       compress=compress_dtype(cfg))
 
 
 def plan_buckets(leaf_bytes: Sequence[int],
@@ -220,7 +267,7 @@ def _param_specs(params: Any, mesh: Mesh):
                                   is_leaf=lambda x: hasattr(x, "spec"))
 
 
-def _exchange_bucket(leaves, specs, out_specs=None):
+def _exchange_bucket(leaves, specs, out_specs=None, compress=None):
     """One bucket's gradient exchange: replicated leaves ride a single
     tuple-psum over both batch axes (one collective issue); fsdp-sharded
     leaves psum over ``data`` and psum_scatter over ``fsdp`` on their
@@ -231,9 +278,20 @@ def _exchange_bucket(leaves, specs, out_specs=None):
     a ``data`` dim per leaf: those leaves reduce-SCATTER over ``data``
     instead of psumming, so each replica receives only its optimizer
     shard's gradient slice — 1/N the data-axis payload, landing exactly
-    in the sharded weight-update layout."""
+    in the sharded weight-update layout.
+
+    ``compress`` ("bf16"/"fp16", comm.compress): the payload is cast to
+    the compressed dtype BEFORE its collectives and re-materialized f32
+    after — the wire carries half the bytes; every f32 accumulation
+    around the exchange (local grads, the optimizer) is untouched. The
+    cast is per-leaf, so it commutes with bucketing: many-vs-one-bucket
+    stays bit-identical under compression."""
     if out_specs is None:
         out_specs = specs
+    in_dt = leaves[0].dtype if leaves else jnp.float32
+    if compress is not None:
+        cdt = COMPRESS_DTYPES[compress]
+        leaves = [l.astype(cdt) for l in leaves]
     z1_dims = [_axis_dim(o, "data") for o in out_specs]
     rep_idx = [i for i, s in enumerate(specs)
                if _fsdp_dim(s) is None and z1_dims[i] is None]
@@ -262,6 +320,10 @@ def _exchange_bucket(leaves, specs, out_specs=None):
         else:
             leaf = lax.psum(leaf, "data")
         out[i] = leaf
+    if compress is not None:
+        # f32 re-materialization: everything downstream of the exchange
+        # (grad-norm metric, optimizer update) accumulates full-precision
+        out = [v.astype(in_dt) for v in out]
     return out
 
 
@@ -272,7 +334,8 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                        label_smoothing: float = 0.0,
                        fused_xent: str = "off",
                        aux_loss_weight: float = 0.01,
-                       zero1_min_size: Optional[int] = None) -> Callable:
+                       zero1_min_size: Optional[int] = None,
+                       precision=None) -> Callable:
     """Drop-in replacement for ``jax.value_and_grad(loss_fn, has_aux=True)``
     in train/loop.make_train_step's single step:
 
@@ -292,7 +355,13 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
     assigns a ``data`` dim reduce-SCATTER over ``data`` and come out in
     the sharded weight-update layout — the optimizer then updates only
     each replica's shard, and the bucketed all-gather
-    (``make_bucketed_gather``) brings the param updates back."""
+    (``make_bucketed_gather``) brings the param updates back.
+
+    ``precision`` (``parallel.precision.PrecisionPolicy``): the SAME
+    policy input cast the jit path's loss_fn applies
+    (train/loop.make_train_step) — the shard_map body must mirror it or
+    the overlap step would compute a different program than the step it
+    replaces."""
     from .mesh import batch_shard_count, shard_map_compat
     from ..train.loop import make_ce_fn
     from ..train.optimizers import loss_weight_decay
@@ -328,7 +397,9 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
 
             def local_loss(pf, bs):
                 variables = {"params": pf, "batch_stats": bs}
-                logits, mutated = apply_fn(variables, images_l, train=True,
+                imgs = images_l if precision is None \
+                    else precision.cast_compute(images_l)
+                logits, mutated = apply_fn(variables, imgs, train=True,
                                            mutable=["batch_stats",
                                                     "losses"])
                 # local CONTRIBUTION to the global mean loss: sum of this
@@ -362,23 +433,36 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                               np.dtype(g.dtype).itemsize) for g in leaves]
             buckets = plan_buckets(leaf_bytes, plan.bucket_bytes)
             bucket_sizes = [sum(leaf_bytes[i] for i in b) for b in buckets]
+            # the bucket PLAN is computed from the uncompressed leaf
+            # bytes either way — compression narrows the wire format on
+            # the same plan, so A/B rows compare like for like
+            if plan.compress is not None:
+                ratio = np.dtype(COMPRESS_DTYPES[plan.compress]).itemsize \
+                    / np.dtype(np.float32).itemsize
+                wire_sizes = [int(b * ratio) for b in bucket_sizes]
+            else:
+                wire_sizes = bucket_sizes
             overlap_stats.record(plan.bucket_bytes, bucket_sizes,
                                  [len(b) for b in buckets],
-                                 sum(leaf_bytes), len(leaves))
+                                 sum(leaf_bytes), len(leaves),
+                                 compress=plan.compress,
+                                 wire_bytes=wire_sizes)
             out_leaves: List[Any] = [None] * len(leaves)
             anchor = None
-            for b, nbytes in zip(buckets, bucket_sizes):
+            for b, nbytes, wbytes in zip(buckets, bucket_sizes,
+                                         wire_sizes):
                 # flight recorder: one (trace-time) span per planned
                 # bucket — the plan is visible in trace.json without
                 # instrumenting the compiled program itself
                 with span("comm.bucket", bytes=int(nbytes),
-                          leaves=len(b)):
+                          wire_bytes=int(wbytes), leaves=len(b)):
                     vals = [leaves[i] for i in b]
                     if anchor is not None:
                         vals, _ = lax.optimization_barrier((vals, anchor))
                     exchanged = _exchange_bucket(
                         vals, [spec_leaves[i] for i in b],
-                        out_specs=[z1_leaves[i] for i in b])
+                        out_specs=[z1_leaves[i] for i in b],
+                        compress=plan.compress)
                     anchor = exchanged[0]
                     for i, v in zip(b, exchanged):
                         out_leaves[i] = v
@@ -409,7 +493,14 @@ def make_bucketed_gather(plan: OverlapPlan, mesh: Mesh,
     later buckets' updates. Leaves the rule table left replicated pass
     through untouched. The gather payload plan is recorded into
     ``parallel.sharding.zero1_stats`` (the ``zero1`` metrics row /
-    bench's payload accounting)."""
+    bench's payload accounting).
+
+    Under ``comm.compress`` (plan.compress) the gathered param-UPDATE
+    payload is cast to the compressed dtype for the all-gather and
+    re-materialized f32 after — the return leg halves like the exchange.
+    Every replica applies the SAME bf16-rounded update (the rounding
+    happens before the gather), so params stay replica-consistent; the
+    f32 masters accumulate the update in f32 as always."""
     from .mesh import shard_map_compat
     from .sharding import zero1_stats
 
@@ -429,8 +520,16 @@ def make_bucketed_gather(plan: OverlapPlan, mesh: Mesh,
                    for b in plan_buckets(gbytes, plan.bucket_bytes)]
         leaf_bytes = {i: nb for i, nb in zip(gidx, gbytes)}
         gathered_sizes = [sum(leaf_bytes[i] for i in b) for b in buckets]
+        if plan.compress is not None:
+            cratio = np.dtype(COMPRESS_DTYPES[plan.compress]).itemsize \
+                / np.dtype(np.float32).itemsize
+            gathered_wire = [int(b * cratio) for b in gathered_sizes]
+        else:
+            gathered_wire = gathered_sizes
         zero1_stats.record_gather(gathered_sizes,
-                                  [len(b) for b in buckets])
+                                  [len(b) for b in buckets],
+                                  compress=plan.compress,
+                                  wire_bytes=gathered_wire)
         base_specs = [P(*(None if n == "data" else n for n in s))
                       if d is not None else s
                       for s, d in zip(specs, z1_dims)]
@@ -438,15 +537,21 @@ def make_bucketed_gather(plan: OverlapPlan, mesh: Mesh,
         def body(*leaves):
             out: List[Any] = list(leaves)  # pass-throughs stay as-is
             anchor = None
-            for b, nbytes in zip(buckets, gathered_sizes):
-                with span("zero1.gather", bytes=int(nbytes)):
+            for b, nbytes, wbytes in zip(buckets, gathered_sizes,
+                                         gathered_wire):
+                with span("zero1.gather", bytes=int(nbytes),
+                          wire_bytes=int(wbytes)):
                     vals = [leaves[i] for i in b]
                     if anchor is not None:
                         vals, _ = lax.optimization_barrier((vals, anchor))
                     for i, v in zip(b, vals):
-                        out[i] = lax.all_gather(v, "data",
-                                                axis=z1_dims[i],
-                                                tiled=True)
+                        if plan.compress is not None:
+                            v = v.astype(COMPRESS_DTYPES[plan.compress])
+                        v = lax.all_gather(v, "data", axis=z1_dims[i],
+                                           tiled=True)
+                        if plan.compress is not None:
+                            v = v.astype(leaves[i].dtype)
+                        out[i] = v
                     anchor = out[b[0]]
             return tuple(out)
 

@@ -1,0 +1,224 @@
+"""End-to-end mixed-precision policy: bf16 hot paths, f32 masters.
+
+Compute-side MFU has been flat at ~0.35 on imagenet-rn50 since BENCH_r02
+because every hot path still ran f32 end to end. This module is the ONE
+resolution point for the three low-precision knobs (docs/precision.md):
+
+  * ``train.precision`` — the TRAINING STEP policy. ``bf16`` computes
+    activations/matmuls in bfloat16 while the parameters (and the whole
+    optimizer state) stay float32 MASTERS: the model is built with a
+    bf16 compute dtype (flax casts params leaf-by-leaf at each op — the
+    policy cast that wraps model apply), gradients come out f32 (the
+    cast's transpose re-accumulates into the f32 param cotangent), and
+    the optimizer update runs entirely in f32. BN moments, softmax and
+    the loss already accumulate in f32 by construction (ops/batch_norm
+    computes moments in f32; train/loop.make_ce_fn casts logits to f32
+    before the softmax). ``off`` (the default) leaves the legacy
+    ``model.compute_dtype`` contract untouched — BIT-identical to the
+    pre-policy step, the exactness oracle every cast path is tested
+    against.
+  * ``comm.compress`` — the GRADIENT-EXCHANGE payload dtype
+    (parallel/overlap.py): each ``comm.bucket`` psum / reduce-scatter /
+    ZeRO-1 all-gather payload is cast to bf16/fp16 on the wire and
+    re-materialized f32 on arrival, halving inter-host bytes on the SAME
+    bucket plan (arXiv:1811.05233 trained ImageNet/ResNet-50 to
+    reference accuracy with half-precision allreduce). Resolved by
+    ``parallel.overlap.compress_dtype``; it rides the bucketed exchange,
+    so the Trainer warns loudly when compression is requested while
+    ``comm.overlap`` resolves off.
+  * ``serve.variants`` — reduced-precision SERVING variants
+    (serve/compile_cache.py buckets become (batch, variant)): a ``bf16``
+    variant serves from a bf16-cast weight copy through a bf16-compute
+    predict step. Resolved by :func:`resolve_serve_variants`.
+
+Checkpoints are policy-agnostic by construction: the masters are f32, so
+save/restore and the serving hot swap never see a cast leaf —
+:func:`check_master_dtypes` is the guard that keeps that true.
+
+Why fp16 is exchange-only: an fp16 TRAINING step needs loss scaling to
+keep small gradients out of the subnormal range (bf16 shares f32's
+exponent and does not); until a scaler exists, ``train.precision=fp16``
+is refused with that reason rather than silently diverging.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: dtypes a policy / compressed exchange / serving variant may name
+POLICY_DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
+
+#: serving-variant names → compute/weight dtype (``f32`` is the
+#: policy-native full-precision variant every server carries implicitly)
+SERVE_VARIANT_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Resolved ``train.precision`` for one Trainer: compute in
+    ``compute_dtype``, keep ``master_dtype`` parameters/optimizer state."""
+
+    name: str                       # "bf16"
+    compute_dtype: Any              # jnp.bfloat16
+    master_dtype: Any = jnp.float32
+
+    @property
+    def compute_dtype_name(self) -> str:
+        return jnp.dtype(self.compute_dtype).name
+
+    def cast_compute(self, x: jax.Array) -> jax.Array:
+        """The policy input cast (wraps model apply): float arrays enter
+        the model in the compute dtype; integer inputs (raw uint8 crops
+        headed for the device augment) pass through untouched."""
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return x.astype(self.compute_dtype)
+        return x
+
+
+def precision_unsupported_reason(cfg) -> Optional[str]:
+    """None when ``train.precision`` can apply to this config; else a
+    one-line reason (``resolve_precision`` raises it — a precision knob
+    that silently trains a different program than requested is exactly
+    the failure mode the resolver exists to prevent)."""
+    mode = cfg.train.precision
+    if mode in ("off", "bf16"):
+        return None
+    if mode == "fp16":
+        return ("an fp16 TRAINING step needs loss scaling to keep small "
+                "gradients out of the subnormal range (bf16 shares f32's "
+                "exponent range and does not) — use train.precision=bf16; "
+                "fp16 is available for the exchange payload "
+                "(comm.compress=fp16)")
+    return f"unknown train.precision setting {mode!r}"
+
+
+def resolve_precision(cfg) -> Optional[PrecisionPolicy]:
+    """``train.precision`` → a :class:`PrecisionPolicy` or None (off =
+    the legacy ``model.compute_dtype`` contract, bit-identical)."""
+    mode = cfg.train.precision
+    if mode == "off":
+        return None
+    reason = precision_unsupported_reason(cfg)
+    if reason is not None:
+        raise ValueError(f"train.precision={mode!r} is unsupported: "
+                         f"{reason}")
+    return PrecisionPolicy(name=mode, compute_dtype=POLICY_DTYPES[mode])
+
+
+def resolve_serve_variants(cfg) -> Tuple[str, ...]:
+    """``serve.variants`` → validated, deduped variant tuple (order
+    preserved; the FIRST entry is the default a variant-less request is
+    served from). Unknown names raise with the supported set — a
+    misspelled variant must never fall back to silently serving f32."""
+    raw = cfg.serve.variants or ("f32",)
+    if isinstance(raw, str):
+        raw = (raw,)
+    out = []
+    for v in raw:
+        if v not in SERVE_VARIANT_DTYPES:
+            raise ValueError(
+                f"unknown serve variant {v!r}; supported: "
+                f"{sorted(SERVE_VARIANT_DTYPES)}")
+        if v not in out:
+            out.append(v)
+    return tuple(out)
+
+
+def make_variant_cast(variant: str):
+    """``cast(state) -> state`` for one serving variant: float leaves of
+    params/batch_stats narrowed to the variant dtype (step/int leaves and
+    the optimizer state untouched — serving never reads moments). The
+    f32 variant is the identity, so the default server pays nothing.
+    Works on live device trees (eager per-leaf casts on the caller
+    thread — serve/server.py builds variants at startup and at swap
+    boundaries, both single-dispatch-thread safe) AND under
+    ``jax.eval_shape`` (serve/compile_cache.py derives each variant's
+    abstract state the same way, so the two cannot drift)."""
+    dt = SERVE_VARIANT_DTYPES[variant]
+    if dt == jnp.float32:
+        return lambda state: state
+
+    def cast_leaf(x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x).astype(dt)
+        return x
+
+    def cast(state):
+        return state.replace(
+            params=jax.tree_util.tree_map(cast_leaf, state.params),
+            batch_stats=jax.tree_util.tree_map(cast_leaf,
+                                               state.batch_stats))
+
+    return cast
+
+
+def check_master_dtypes(params, master_dtype=jnp.float32) -> None:
+    """Raise when any floating param leaf is not a ``master_dtype``
+    master. The precision policy's whole checkpoint story — save/restore
+    and serve hot-swap staying policy-agnostic — rests on the persisted
+    tree being f32; a model that initialized a cast leaf (a param_dtype
+    override drifting in) would silently bake the policy into every
+    checkpoint it writes."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        dt = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") \
+            else leaf.dtype
+        if jnp.issubdtype(dt, jnp.floating) and dt != jnp.dtype(master_dtype):
+            bad.append(f"{jax.tree_util.keystr(path)}:{jnp.dtype(dt).name}")
+    if bad:
+        raise ValueError(
+            f"precision policy requires {jnp.dtype(master_dtype).name} "
+            f"master params but found {bad[:5]} — a non-master float leaf "
+            "would bake the compute policy into every checkpoint")
+
+
+class PrecisionStats:
+    """Process-global record of the resolved precision/compression
+    configuration — what the ``{"event": "precision"}`` metrics row
+    (train/hooks.PrecisionHook) and bench.py's ``precision`` row export.
+    Mirrors overlap_stats' contract: written at Trainer build /
+    state-init time (a property of the run, not of any step)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snap: Optional[Dict[str, Any]] = None
+
+    def record_policy(self, policy: Optional[PrecisionPolicy],
+                      compress: Optional[str]) -> None:
+        with self._lock:
+            base = self._snap or {}
+            self._snap = {**base,
+                          "policy": policy.name if policy else "off",
+                          "compute_dtype": policy.compute_dtype_name
+                          if policy else None,
+                          "master_dtype": jnp.dtype(
+                              policy.master_dtype).name if policy
+                          else None,
+                          "compress": compress or "off"}
+
+    def record_params(self, params) -> None:
+        """Master-tree accounting from the LIVE state: leaf count and f32
+        master bytes (what checkpoints persist regardless of policy)."""
+        leaves = jax.tree_util.tree_leaves(params)
+        nbytes = sum(int(l.size) * jnp.dtype(l.dtype).itemsize
+                     for l in leaves)
+        with self._lock:
+            base = self._snap or {}
+            self._snap = {**base, "param_leaves": len(leaves),
+                          "master_param_bytes": int(nbytes)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._snap = None
+
+    def snapshot(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._snap) if self._snap is not None else None
+
+
+#: process-global precision telemetry (one policy resolution per process)
+precision_stats = PrecisionStats()

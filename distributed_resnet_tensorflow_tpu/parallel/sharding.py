@@ -378,17 +378,24 @@ class Zero1Stats:
             base = self._snap or {}
             self._snap = {**base, **report.snapshot()}
 
-    def record_gather(self, bucket_bytes, bucket_leaves) -> None:
+    def record_gather(self, bucket_bytes, bucket_leaves,
+                      compress=None, wire_bytes=None) -> None:
         """Bucketed param-update all-gather plan (parallel/overlap.py):
-        per-bucket FULL-leaf bytes in issue order."""
+        per-bucket FULL-leaf bytes in issue order. ``compress`` /
+        ``wire_bytes`` carry the comm.compress wire format (the SAME
+        plan, narrower payload — docs/precision.md)."""
+        bucket_bytes = [int(b) for b in bucket_bytes]
         with self._lock:
             base = self._snap or {}
             self._snap = {**base,
-                          "gather_buckets": len(list(bucket_bytes)),
-                          "gather_bucket_bytes": [int(b) for b in
-                                                  bucket_bytes],
+                          "gather_buckets": len(bucket_bytes),
+                          "gather_bucket_bytes": bucket_bytes,
                           "gather_bucket_leaves": [int(n) for n in
-                                                   bucket_leaves]}
+                                                   bucket_leaves],
+                          "gather_compress": compress or "off",
+                          "gather_wire_bytes":
+                              [int(b) for b in wire_bytes]
+                              if wire_bytes is not None else bucket_bytes}
 
     def reset(self) -> None:
         with self._lock:

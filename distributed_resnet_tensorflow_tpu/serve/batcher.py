@@ -40,10 +40,11 @@ log = logging.getLogger(__name__)
 
 
 class _Request:
-    __slots__ = ("image", "future", "t_submit")
+    __slots__ = ("image", "variant", "future", "t_submit")
 
-    def __init__(self, image):
+    def __init__(self, image, variant: str = "f32"):
         self.image = image
+        self.variant = variant
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
 
@@ -62,7 +63,8 @@ class DynamicBatcher:
                  dispatch_fn: Callable[[np.ndarray, List[_Request]], None],
                  image_shape, image_dtype,
                  max_queue_delay_ms: float = 5.0,
-                 boundary_hook: Optional[Callable[[], None]] = None):
+                 boundary_hook: Optional[Callable[[], None]] = None,
+                 variants: Sequence[str] = ("f32",)):
         from .compile_cache import pick_bucket
         self._pick_bucket = pick_bucket
         self.buckets = sorted(int(b) for b in buckets)
@@ -72,6 +74,12 @@ class DynamicBatcher:
         self._image_dtype = np.dtype(image_dtype)
         self.max_queue_delay_ms = float(max_queue_delay_ms)
         self._boundary_hook = boundary_hook
+        # serving variants (docs/precision.md): requests name one; a batch
+        # is single-variant (one compiled program per dispatch), so a
+        # variant change splits the group. FIRST entry = the default a
+        # variant-less submit gets.
+        self.variants = tuple(variants) or ("f32",)
+        self._held: Optional[_Request] = None  # cross-variant spillover
         self._q: queue_mod.Queue = queue_mod.Queue()
         self._stop = threading.Event()
         self._closed = threading.Event()
@@ -84,17 +92,31 @@ class DynamicBatcher:
         self._in_lock = threading.Lock()
 
     # -- submitter side ----------------------------------------------------
-    def submit(self, image) -> Future:
-        """Enqueue one example; returns the request's Future. Any thread."""
+    def submit(self, image, variant: Optional[str] = None) -> Future:
+        """Enqueue one example; returns the request's Future. Any thread.
+
+        ``variant`` picks the serving precision variant (None = the
+        configured default). Strict like the dtype/shape checks below: an
+        unknown variant is rejected loudly, never silently served f32 —
+        the client asked for a latency/precision contract this replica
+        does not carry."""
         if self._closed.is_set():
             raise RuntimeError("batcher is closed; request rejected")
+        if variant is None:
+            variant = self.variants[0]
+        elif variant not in self.variants:
+            raise ValueError(
+                f"unknown serve variant {variant!r}; this replica serves "
+                f"{list(self.variants)} (serve.variants)")
         arr = np.asarray(image)
         if arr.dtype != self._image_dtype:
             # strict, no silent cast: float32-[0,1] crops coerced to a
             # uint8 spec would truncate to black, uint8 to a float32 spec
             # would serve unstandardized pixels — both answer confidently
             # with garbage. Requests must arrive prepped exactly as the
-            # eval input pipeline delivers them (serve_image_spec).
+            # eval input pipeline delivers them (serve_image_spec). The
+            # request dtype is VARIANT-INDEPENDENT: variants change the
+            # weights/compute, never the input contract.
             raise ValueError(
                 f"request image dtype {arr.dtype} != serving spec "
                 f"{self._image_dtype}")
@@ -102,7 +124,7 @@ class DynamicBatcher:
             raise ValueError(
                 f"request image shape {arr.shape} != serving spec "
                 f"{self._image_shape}")
-        req = _Request(arr)
+        req = _Request(arr, variant)
         with self._in_lock:
             # the closed-check and the enqueue share one lock with
             # close(): once close() flips _closed under this lock, no
@@ -117,23 +139,34 @@ class DynamicBatcher:
     # -- dispatch side -----------------------------------------------------
     def _collect(self, block_secs: float) -> Optional[List[_Request]]:
         """One group: the first request (waiting up to ``block_secs``), then
-        late arrivals up to ``max_queue_delay_ms`` or the largest bucket."""
-        try:
-            first = self._q.get(timeout=block_secs) if block_secs > 0 \
-                else self._q.get_nowait()
-        except queue_mod.Empty:
-            return None
+        late arrivals up to ``max_queue_delay_ms`` or the largest bucket.
+        A group is single-VARIANT (one compiled program per dispatch): a
+        request for another variant ends the group and is held as the
+        next group's head — FIFO order across variants is preserved, a
+        mixed stream just batches a little smaller."""
+        if self._held is not None:
+            first, self._held = self._held, None
+        else:
+            try:
+                first = self._q.get(timeout=block_secs) if block_secs > 0 \
+                    else self._q.get_nowait()
+            except queue_mod.Empty:
+                return None
         group = [first]
         deadline = time.perf_counter() + self.max_queue_delay_ms / 1000.0
         while len(group) < self.max_batch:
             remaining = deadline - time.perf_counter()
             try:
-                group.append(self._q.get(timeout=max(0.0, remaining))
-                             if remaining > 0 else self._q.get_nowait())
+                nxt = self._q.get(timeout=max(0.0, remaining)) \
+                    if remaining > 0 else self._q.get_nowait()
             except queue_mod.Empty:
                 if remaining <= 0:
                     break
                 continue
+            if nxt.variant != first.variant:
+                self._held = nxt
+                break
+            group.append(nxt)
         return group
 
     def _dispatch(self, group: List[_Request]) -> None:

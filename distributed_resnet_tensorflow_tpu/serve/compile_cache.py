@@ -11,7 +11,16 @@ by a compile hiding behind an unlucky batch size.
 Buckets are powers of two (in multiples of ``Trainer.eval_pad_multiple``,
 so every padded batch divides over the batch shards × pipeline
 microbatches) up to the request-batch cap — a handful of programs total,
-compiled once, keyed by (bucket, image shape, dtype).
+compiled once, keyed by (bucket, image shape, dtype, VARIANT).
+
+Variants (``serve.variants``; docs/precision.md): each reduced-precision
+serving variant ("bf16") gets its own predict program per bucket —
+compiled against the variant's CAST abstract state
+(``parallel.precision.make_variant_cast``, the same cast the server
+applies to the live weights), so a bf16 variant executes bf16 weights
+through a bf16-compute forward while the f32 variant stays the untouched
+full-precision oracle. The shardings are dtype-free, so every variant
+shares the layout machinery.
 """
 from __future__ import annotations
 
@@ -72,38 +81,55 @@ class ServeCompileCache:
     (serve/batcher.py; docs/input_pipeline.md threading model).
     """
 
-    def __init__(self, trainer):
+    def __init__(self, trainer, variant_predicts=None):
         from ..parallel.mesh import data_sharding
+        from ..parallel.precision import make_variant_cast
         from ..train.state import state_shardings
         self.trainer = trainer
         self._state_abstract = jax.eval_shape(lambda s: s, trainer.state)
         self._st_sh = state_shardings(self._state_abstract, trainer.mesh)
         self._b_sh = data_sharding(trainer.mesh)
+        # variant → (predict step, CAST abstract state): "f32" is the
+        # trainer's own forward over the uncast state; reduced-precision
+        # variants come from Trainer.make_variant_predict_step with the
+        # abstract cast exactly as the server casts the live weights
+        self._predicts = {"f32": trainer._predict_step}
+        self._abstracts = {"f32": self._state_abstract}
+        for name, fn in (variant_predicts or {}).items():
+            self._predicts[name] = fn
+            self._abstracts[name] = jax.eval_shape(
+                make_variant_cast(name), self._state_abstract)
         self._compiled: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
         self.warm_secs = 0.0
         self.serve_time_compiles = 0
 
     def _key(self, bucket: int, image_shape: Tuple[int, ...],
-             dtype) -> Tuple:
-        return (int(bucket), tuple(image_shape), np.dtype(dtype).str)
+             dtype, variant: str) -> Tuple:
+        return (int(bucket), tuple(image_shape), np.dtype(dtype).str,
+                str(variant))
 
-    def _compile(self, bucket: int, image_shape: Tuple[int, ...], dtype):
+    def _compile(self, bucket: int, image_shape: Tuple[int, ...], dtype,
+                 variant: str):
+        if variant not in self._predicts:
+            raise ValueError(f"serve variant {variant!r} has no predict "
+                             f"program; have {sorted(self._predicts)}")
         batch_abstract = {"images": jax.ShapeDtypeStruct(
             (bucket,) + tuple(image_shape), np.dtype(dtype))}
-        jitted = jax.jit(self.trainer._predict_step,
+        jitted = jax.jit(self._predicts[variant],
                          in_shardings=(self._st_sh, {"images": self._b_sh}))
-        return jitted.lower(self._state_abstract, batch_abstract).compile()
+        return jitted.lower(self._abstracts[variant],
+                            batch_abstract).compile()
 
     def get(self, bucket: int, image_shape: Tuple[int, ...], dtype,
-            warm: bool = False):
-        key = self._key(bucket, image_shape, dtype)
+            variant: str = "f32", warm: bool = False):
+        key = self._key(bucket, image_shape, dtype, variant)
         with self._lock:
             hit = self._compiled.get(key)
         if hit is not None:
             return hit
         t0 = time.perf_counter()
-        compiled = self._compile(bucket, image_shape, dtype)
+        compiled = self._compile(bucket, image_shape, dtype, variant)
         dt = time.perf_counter() - t0
         with self._lock:
             # a concurrent compile of the same key may have won the race;
@@ -114,24 +140,26 @@ class ServeCompileCache:
             elif hit is compiled:
                 self.serve_time_compiles += 1
         if warm:
-            log.info("serve compile cache: bucket %d %s %s compiled in "
-                     "%.2fs", bucket, tuple(image_shape),
-                     np.dtype(dtype).name, dt)
+            log.info("serve compile cache: bucket %d %s %s [%s] compiled "
+                     "in %.2fs", bucket, tuple(image_shape),
+                     np.dtype(dtype).name, variant, dt)
         elif hit is compiled:
             log.warning(
                 "serve compile cache MISS at request time: bucket %d %s %s "
-                "compiled in %.2fs on the request path — the warmup spec "
-                "and live traffic disagree (serve.warm_buckets / request "
-                "dtype)", bucket, tuple(image_shape), np.dtype(dtype).name,
-                dt)
+                "[%s] compiled in %.2fs on the request path — the warmup "
+                "spec and live traffic disagree (serve.warm_buckets / "
+                "request dtype / serve.variants)", bucket,
+                tuple(image_shape), np.dtype(dtype).name, variant, dt)
         return hit
 
     def warm(self, buckets: List[int], image_shape: Tuple[int, ...],
-             dtype) -> float:
-        """Compile every bucket now; returns total compile seconds."""
+             dtype, variants: Tuple[str, ...] = ("f32",)) -> float:
+        """Compile every (bucket, variant) now; returns total compile
+        seconds."""
         t0 = time.perf_counter()
-        for b in buckets:
-            self.get(b, image_shape, dtype, warm=True)
+        for v in variants:
+            for b in buckets:
+                self.get(b, image_shape, dtype, variant=v, warm=True)
         return time.perf_counter() - t0
 
     @property

@@ -35,12 +35,16 @@ def synthetic_requests(image_shape, dtype, pool: int = 32, seed: int = 0):
 
 
 def run_open_loop(server, qps: float, duration_secs: float,
-                  seed: int = 0, timeout_secs: Optional[float] = None
-                  ) -> dict:
+                  seed: int = 0, timeout_secs: Optional[float] = None,
+                  variant: Optional[str] = None) -> dict:
     """Offer ``qps`` requests/sec for ``duration_secs``, then wait for every
     outstanding Future. Returns offered/completed/failed/late counts and
     the achieved submit rate; latency percentiles live in
-    ``server.report()`` (recorded server-side per request)."""
+    ``server.report()`` (recorded server-side per request).
+
+    ``variant`` targets one serving precision variant (docs/precision.md;
+    None = the replica's default) — bench's (batch, variant) serving row
+    drives one open loop per variant."""
     n = max(1, int(qps * duration_secs))
     pool = synthetic_requests(server.image_shape, server.image_dtype,
                               seed=seed)
@@ -54,7 +58,7 @@ def run_open_loop(server, qps: float, duration_secs: float,
             time.sleep(target - now)
         elif now - target > 0.5:
             late += 1  # generator itself fell behind the open-loop clock
-        futures.append(server.submit(pool[i % len(pool)]))
+        futures.append(server.submit(pool[i % len(pool)], variant=variant))
     submit_wall = time.perf_counter() - t0
     done, not_done = futures_wait(
         futures, timeout=timeout_secs if timeout_secs is not None

@@ -59,11 +59,18 @@ class InferenceServer:
 
     Single-process (a serving replica is one jax world; fleet-level
     replication is the launcher's job). ``start()`` restores the newest
-    committed checkpoint (if any), AOT-warms every bucket, then starts the
-    dispatch + swap threads; ``submit()`` returns a Future resolving to
-    ``(logits_row, served_step)``. ``start(start_threads=False)`` leaves
-    the threads off for deterministic single-thread driving
-    (``service_once`` — tests, bench warm paths).
+    committed checkpoint (if any), AOT-warms every (bucket, variant),
+    then starts the dispatch + swap threads; ``submit()`` returns a
+    Future resolving to ``(logits_row, served_step)``.
+    ``start(start_threads=False)`` leaves the threads off for
+    deterministic single-thread driving (``service_once`` — tests, bench
+    warm paths).
+
+    Variants (``serve.variants``; docs/precision.md): each configured
+    precision variant ("bf16") carries its own weight copy cast from the
+    f32 masters and its own AOT bucket programs; requests pick one at
+    ``submit(variant=...)`` and hot swaps rebuild every variant from the
+    newly restored masters, so no variant can lag a checkpoint behind.
     """
 
     def __init__(self, cfg: ExperimentConfig,
@@ -76,13 +83,45 @@ class InferenceServer:
         self.writer = writer
         self.trainer = Trainer(cfg, mesh=mesh)
         self.trainer.init_state()
-        self._state = self.trainer.state
+        # serving precision variants (docs/precision.md): every variant
+        # keeps its own weight copy cast from the f32 masters + its own
+        # AOT programs; the FIRST is the default a variant-less request
+        # gets. The f32 masters themselves live on the trainer state —
+        # variants are rebuilt from them at every (startup/hot) swap.
+        from ..parallel.precision import (SERVE_VARIANT_DTYPES,
+                                          make_variant_cast,
+                                          resolve_serve_variants)
+        self.variants = resolve_serve_variants(cfg)
+        self._variant_casts = {v: make_variant_cast(v)
+                               for v in self.variants}
+        # the f32 MASTER state every variant casts from — kept even when
+        # "f32" is not a served variant (swap validation compares
+        # checkpoints against the masters, never a cast copy). Variant
+        # weight copies are built LAZILY (start() after the restore
+        # attempt, or first dispatch): casting fresh-init params that a
+        # startup restore immediately replaces would waste a per-leaf
+        # device cast and transient HBM per non-f32 variant.
+        self._master_state = self.trainer.state
+        self._states = None
         self.serving_step = -1  # -1 = fresh init, no checkpoint applied
         self.image_shape, self.image_dtype = serve_image_spec(cfg)
         max_batch = cfg.serve.max_batch or cfg.data.eval_batch_size
         self.buckets = bucket_sizes(max_batch,
                                     self.trainer.eval_pad_multiple())
-        self.cache = ServeCompileCache(self.trainer)
+        variant_predicts = {
+            v: self.trainer.make_variant_predict_step(
+                SERVE_VARIANT_DTYPES[v])
+            for v in self.variants if v != "f32"}
+        if "f32" in self.variants and self.trainer.precision_active:
+            # the f32 variant is the FULL-PRECISION oracle even when the
+            # serving config carries a bf16 TRAINING policy: the
+            # trainer's own predict step computes in the policy dtype,
+            # so the f32 variant needs its own f32-compute program
+            variant_predicts["f32"] = \
+                self.trainer.make_variant_predict_step(
+                    SERVE_VARIANT_DTYPES["f32"])
+        self.cache = ServeCompileCache(self.trainer,
+                                       variant_predicts=variant_predicts)
         self.latency = LatencyStats()
         self.swapper = CheckpointSwapper(
             resolve_checkpoint_dir(cfg),
@@ -93,7 +132,8 @@ class InferenceServer:
             self.buckets, self._run_bucket, self.image_shape,
             self.image_dtype,
             max_queue_delay_ms=cfg.serve.max_queue_delay_ms,
-            boundary_hook=self._apply_pending_swap)
+            boundary_hook=self._apply_pending_swap,
+            variants=self.variants)
         self.completed = 0
         self.swaps = 0
         self._t_start = time.monotonic()
@@ -111,7 +151,7 @@ class InferenceServer:
         pending = self.swapper.take_pending() \
             if self.swapper.restore_newest_valid() is not None else None
         if pending is not None:
-            self._apply_swap(pending)
+            self._apply_swap(pending)  # builds the variant states
             # `swaps` counts HOT swaps (a checkpoint published while
             # serving): the startup restore is not one, and counting it
             # would let the smoke's "a hot swap landed" assertion pass
@@ -122,11 +162,16 @@ class InferenceServer:
                 "serve: no usable committed checkpoint in %s — serving "
                 "freshly initialized params until a training run "
                 "publishes one", self.swapper.directory)
+        if self._states is None:  # no restore landed: cast the init state
+            self._states = self._build_variant_states(self._master_state)
         if self.cfg.serve.warm_buckets:
             warm = self.cache.warm(self.buckets, self.image_shape,
-                                   self.image_dtype)
-            log.info("serve: %d bucket(s) %s AOT-compiled in %.1fs",
-                     len(self.buckets), self.buckets, warm)
+                                   self.image_dtype,
+                                   variants=self.variants)
+            log.info("serve: %d bucket(s) %s × %d variant(s) %s "
+                     "AOT-compiled in %.1fs", len(self.buckets),
+                     self.buckets, len(self.variants),
+                     list(self.variants), warm)
         if start_threads:
             # a jitted state init already ran on this (caller) thread; the
             # dispatch thread owns all multi-device executions from here on
@@ -150,10 +195,25 @@ class InferenceServer:
         self.swapper.close()
         self._write_request_summary()
 
+    # -- variant states ----------------------------------------------------
+    def _build_variant_states(self, f32_state):
+        """Cast the f32 master state into every configured variant's
+        weight copy (parallel/precision.make_variant_cast). Runs on the
+        thread that owns dispatch at the time: the caller thread during
+        __init__/startup (before the dispatch thread exists), the
+        dispatch thread at hot-swap boundaries."""
+        out = {}
+        for v in self.variants:
+            with span("serve.variant_build", variant=v):
+                out[v] = self._variant_casts[v](f32_state)
+        return out
+
     # -- request path ------------------------------------------------------
-    def submit(self, image) -> Future:
-        """One example in, Future of ``(logits_row, served_step)`` out."""
-        return self.batcher.submit(image)
+    def submit(self, image, variant: Optional[str] = None) -> Future:
+        """One example in, Future of ``(logits_row, served_step)`` out.
+        ``variant`` picks the serving precision variant (None = the
+        configured default; unknown names are rejected loudly)."""
+        return self.batcher.submit(image, variant=variant)
 
     def service_once(self, block_secs: float = 0.0) -> int:
         """Single synchronous service turn on the calling thread (see
@@ -162,21 +222,33 @@ class InferenceServer:
 
     def _run_bucket(self, images: np.ndarray, group) -> None:
         """Dispatch-thread only: stage → finalize → compiled predict →
-        resolve futures. ``images`` is already padded to its bucket."""
+        resolve futures. ``images`` is already padded to its bucket; the
+        group is single-variant by the batcher's collection contract."""
         from ..parallel.sharding import finalize_staged
         t0 = time.perf_counter()
         bucket = images.shape[0]
-        with span("serve.batch", bucket=bucket, n=len(group)):
+        variant = group[0].variant
+        if self._states is None:
+            # dispatch before start() (thread-less embedding driving the
+            # batcher directly): build here, on the thread that owns
+            # dispatch by definition
+            self._states = self._build_variant_states(self._master_state)
+        with span("serve.batch", bucket=bucket, n=len(group),
+                  variant=variant):
             compiled = self.cache.get(bucket, self.image_shape,
-                                      self.image_dtype)
+                                      self.image_dtype, variant=variant)
             # the Trainer's put path: CoalescedStager on accelerators (one
             # batched transfer issue), per-leaf device_put fallback on CPU;
             # finalize (a multi-device execution) stays on THIS thread
             dev = finalize_staged(self.trainer._put_batch({"images": images}))
-            logits = np.asarray(compiled(self._state, dev))
+            logits = np.asarray(compiled(self._states[variant], dev))
         t1 = time.perf_counter()
         step = self.serving_step
-        key = f"bucket_{bucket}"
+        # latency keys carry the variant past the default f32 — the
+        # (batch, variant) breakdown bench's serving row reports; plain
+        # f32 keys keep their historical names
+        key = f"bucket_{bucket}" if variant == "f32" \
+            else f"bucket_{bucket}_{variant}"
         for i, req in enumerate(group):
             req.future.set_result((logits[i], step))
             self.latency.record(key, t1 - req.t_submit)
@@ -184,6 +256,7 @@ class InferenceServer:
         if self.writer is not None:
             self.writer.write_event("serve_batch", {
                 "step": step, "bucket": bucket, "n": len(group),
+                "variant": variant,
                 "queue_ms": round((t0 - group[0].t_submit) * 1000.0, 3),
                 "run_ms": round((t1 - t0) * 1000.0, 3)})
 
@@ -202,7 +275,10 @@ class InferenceServer:
     def _apply_swap_inner(self, pending: PendingSwap) -> None:
         from ..parallel.sharding import put_to_sharding
         t0 = time.perf_counter()
-        live = self._state
+        # validate against the F32 MASTER state: checkpoints always
+        # persist f32 masters (docs/precision.md), so the shape/dtype
+        # check must not compare against a cast variant's bf16 leaves
+        live = self._master_state
 
         def check_leaf(host_leaf, live_leaf):
             # validate BEFORE any placement: a same-structure checkpoint
@@ -245,10 +321,13 @@ class InferenceServer:
         old = self.serving_step
         # one reference assignment = the atomic swap: the dispatch thread
         # is the only reader on the request path, and it is HERE, between
-        # batches — in-flight requests completed on `live`, the next batch
-        # reads `self._state`
-        self._state = live.replace(step=new_step, params=new_params,
-                                   batch_stats=new_bs)
+        # batches — in-flight requests completed on the old states, the
+        # next batch reads `self._states`. EVERY variant rebuilds from
+        # the new f32 masters (the cast is the swap's only extra cost),
+        # so no variant can serve a stale checkpoint
+        self._master_state = live.replace(step=new_step, params=new_params,
+                                          batch_stats=new_bs)
+        self._states = self._build_variant_states(self._master_state)
         self.serving_step = int(pending.step)
         self.swaps += 1
         apply_ms = (time.perf_counter() - t0) * 1000.0
@@ -289,6 +368,7 @@ class InferenceServer:
         wall = max(time.monotonic() - self._t_start, 1e-9)
         return {
             "serving_step": self.serving_step,
+            "variants": list(self.variants),
             "requests": self.batcher.requests_in,
             "completed": self.completed,
             "dropped": self.dropped,

@@ -49,7 +49,10 @@ log = logging.getLogger(__name__)
 
 #: bump when the trace.json event shape changes (consumers key on it via
 #: the ``trace_dump`` metrics row and the file's otherData block)
-SPAN_SCHEMA_VERSION = 4  # 4: + checkpoint.shard/checkpoint.finalize/
+SPAN_SCHEMA_VERSION = 5  # 5: + serve.variant_build; comm.bucket /
+#                              zero1.gather gain a wire_bytes arg
+#                              (low-precision hot paths, round 12)
+#                          4: + checkpoint.shard/checkpoint.finalize/
 #                              zero1.gather (ZeRO-1 sharded update +
 #                              per-host sharded checkpoints, round 11)
 #                          3: + checkpoint.snapshot/checkpoint.writer/
@@ -111,6 +114,9 @@ SPAN_CATALOG = {
     "serve.batch": "one bucket dispatch: stage + AOT predict + resolve",
     "serve.swap_restore": "off-path host restore of a newer checkpoint",
     "serve.swap_apply": "atomic param swap at a batch boundary",
+    "serve.variant_build": "one serving precision variant's weight copy "
+                           "cast from the f32 masters (startup and every "
+                           "hot swap; docs/precision.md)",
 }
 
 # unknown span names already warned about (warn once, like write_event)

@@ -56,6 +56,40 @@ class _CadenceHook:
         self._last = min(self._last, step)
 
 
+class _SnapshotExportHook(_CadenceHook):
+    """Shared skeleton for the plan/summary exporters (Zero1Hook,
+    CommOverlapHook, PrecisionHook, CommCompressHook, CkptShardHook):
+    at the cadence, pull a snapshot row and write it as ONE
+    ``{"event": <event>}`` record per CHANGE — these rows describe a
+    property of the run's compiled programs / writer state, not of any
+    single step, so re-exporting an unchanged row per cadence would be
+    noise, while gating on anything less than the whole row freezes
+    mid-flight values forever (the CkptAsyncHook lesson, round 10).
+    Subclasses set ``event`` and implement ``_snapshot() -> dict|None``
+    (None = nothing to export yet)."""
+
+    event: str = ""
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self._last = 0
+        self._exported: Dict[str, Any] = {}
+
+    def _snapshot(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        snap = self._snapshot()
+        if snap is not None and snap != self._exported:
+            self._exported = snap
+            self.writer.write_event(self.event, {"step": int(step),
+                                                 **snap})
+
+
 class LoggingHook(_CadenceHook):
     """Print step/loss/precision/lr every N steps + throughput (reference
     LoggingTensorHook cadence: 20 cifar / 40 imagenet,
@@ -232,45 +266,32 @@ class CkptAsyncHook(_CadenceHook):
                                     {"step": int(step), **snap})
 
 
-class CkptShardHook(_CadenceHook):
+class CkptShardHook(_SnapshotExportHook):
     """Export THIS host's sharded-checkpoint accounting as
     ``{"event": "ckpt_shard"}`` rows every N steps when its shard bytes
     advanced — the per-host view ``main.py monitor`` rolls up into
     cluster shard-byte totals. Unlike the chief-only observability
     hooks this runs on EVERY process (each host stages only its own
     shard; the chief's row alone would claim the cluster wrote 1/N of
-    what it did). Writes nothing on the single-payload layout."""
+    what it did). Writes nothing on the single-payload layout (no
+    shard files ever staged)."""
 
-    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
-        self.writer = writer
-        self.every_steps = max(1, every_steps)
-        self._last = 0
-        self._exported: Dict[str, Any] = {}
+    event = "ckpt_shard"
 
-    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
-        if not cadence_crossed(step, self.every_steps, self._last):
-            return
-        self._last = step
+    def _snapshot(self):
         from ..utils.metrics import ckpt_async_stats
         snap = ckpt_async_stats.snapshot()
-        # gate on the WHOLE row changing, not just shard_bytes (the
-        # CkptAsyncHook lesson): a row exported while the writer sat in
-        # the finalize wait would otherwise freeze last_committed_step /
-        # finalize_wait_seconds at their mid-commit values forever —
-        # exactly the final save of every run
-        row = {"process": jax.process_index(),
-               "shard_bytes": snap["shard_bytes"],
-               "shard_files": snap["shard_files"],
-               "shard_seconds": snap["shard_seconds"],
-               "finalize_wait_seconds": snap["finalize_wait_seconds"],
-               "last_committed_step": snap["last_committed_step"]}
-        if snap["shard_files"] and row != self._exported:
-            self._exported = row
-            self.writer.write_event("ckpt_shard",
-                                    {"step": int(step), **row})
+        if not snap["shard_files"]:
+            return None
+        return {"process": jax.process_index(),
+                "shard_bytes": snap["shard_bytes"],
+                "shard_files": snap["shard_files"],
+                "shard_seconds": snap["shard_seconds"],
+                "finalize_wait_seconds": snap["finalize_wait_seconds"],
+                "last_committed_step": snap["last_committed_step"]}
 
 
-class Zero1Hook(_CadenceHook):
+class Zero1Hook(_SnapshotExportHook):
     """Export the ZeRO-1 partition plan (parallel/sharding.zero1_stats:
     sharded/replicated leaf+byte counts, per-replica optimizer bytes,
     fallback reasons, and — under comm.overlap — the bucketed param-
@@ -279,46 +300,67 @@ class Zero1Hook(_CadenceHook):
     the compiled step. Writes nothing when optimizer.zero1 resolved
     off."""
 
-    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
-        self.writer = writer
-        self.every_steps = max(1, every_steps)
-        self._last = 0
-        self._exported: Dict[str, Any] = {}
+    event = "zero1"
 
-    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
-        if not cadence_crossed(step, self.every_steps, self._last):
-            return
-        self._last = step
+    def _snapshot(self):
         from ..parallel.sharding import zero1_stats
-        snap = zero1_stats.snapshot()
-        if snap is not None and snap != self._exported:
-            self._exported = snap
-            self.writer.write_event("zero1", {"step": int(step), **snap})
+        return zero1_stats.snapshot()
 
 
-class CommOverlapHook(_CadenceHook):
+class PrecisionHook(_SnapshotExportHook):
+    """Export the resolved mixed-precision policy (parallel/precision.
+    precision_stats: policy/compute/master dtypes, effective compression,
+    master-tree accounting) as ONE ``{"event": "precision"}`` row per
+    resolved policy — the per-run precision summary (docs/precision.md).
+    Writes nothing when neither a policy nor compression resolved on."""
+
+    event = "precision"
+
+    def _snapshot(self):
+        from ..parallel.precision import precision_stats
+        return precision_stats.snapshot()
+
+
+class CommCompressHook(_SnapshotExportHook):
+    """Export the compressed-exchange payload accounting (parallel/
+    overlap.overlap_stats wire fields + the ZeRO-1 gather wire plan) as
+    ONE ``{"event": "comm_compress"}`` row per traced plan WHEN
+    ``comm.compress`` actually compressed something — the byte-halving
+    witness next to comm_overlap's bucket plan. Silent when the exchange
+    ran uncompressed (the comm_overlap row already carries wire_bytes ==
+    grad_bytes there)."""
+
+    event = "comm_compress"
+
+    def _snapshot(self):
+        from ..parallel.overlap import overlap_stats
+        from ..parallel.sharding import zero1_stats
+        snap = overlap_stats.snapshot()
+        if snap is None or snap.get("compress", "off") == "off":
+            return None
+        row = {"compress": snap["compress"],
+               "grad_bytes": snap["grad_bytes"],
+               "wire_bytes": snap["wire_bytes"],
+               "bucket_wire_bytes": snap["bucket_wire_bytes"],
+               "wire_ratio": round(snap["wire_bytes"] /
+                                   max(snap["grad_bytes"], 1), 4)}
+        z1 = zero1_stats.snapshot()
+        if z1 is not None and z1.get("gather_compress", "off") != "off":
+            row["gather_wire_bytes"] = z1["gather_wire_bytes"]
+        return row
+
+
+class CommOverlapHook(_SnapshotExportHook):
     """Export the bucketed gradient-exchange plan (parallel/overlap.
     overlap_stats) as ONE ``{"event": "comm_overlap"}`` row per traced
-    plan — the plan is a property of the compiled step, not of any single
-    step, so re-exporting per cadence would be noise. Writes nothing when
-    the overlap path never traced (comm.overlap resolved off)."""
+    plan. Writes nothing when the overlap path never traced
+    (comm.overlap resolved off)."""
 
-    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
-        self.writer = writer
-        self.every_steps = max(1, every_steps)
-        self._last = 0
-        self._exported: Dict[str, Any] = {}
+    event = "comm_overlap"
 
-    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
-        if not cadence_crossed(step, self.every_steps, self._last):
-            return
-        self._last = step
+    def _snapshot(self):
         from ..parallel.overlap import overlap_stats
-        snap = overlap_stats.snapshot()
-        if snap is not None and snap != self._exported:
-            self._exported = snap
-            self.writer.write_event("comm_overlap",
-                                    {"step": int(step), **snap})
+        return overlap_stats.snapshot()
 
 
 class CorruptRecordsHook(_CadenceHook):

@@ -119,7 +119,8 @@ def make_train_step(schedule: Callable, weight_decay: float,
                     augment_seed: int = 0,
                     aux_loss_weight: float = 0.01,
                     value_and_grad_fn: Optional[Callable] = None,
-                    apply_gradients_fn: Optional[Callable] = None):
+                    apply_gradients_fn: Optional[Callable] = None,
+                    precision=None):
     """Build the pure train_step(state, batch) -> (state, metrics).
 
     ``augment_fn(images, rng) -> images`` runs device-side augmentation at
@@ -136,7 +137,14 @@ def make_train_step(schedule: Callable, weight_decay: float,
     ``apply_gradients_fn(state, grads) -> state`` replaces the default
     ``state.apply_gradients(grads)`` — the ZeRO-1 sharded weight update
     (Trainer._make_zero1_apply: reduce-scattered grads → local optimizer
-    shard update → all-gathered param updates) plugs in here."""
+    shard update → all-gathered param updates) plugs in here.
+
+    ``precision`` (a ``parallel.precision.PrecisionPolicy``, or None =
+    the bit-identical legacy path): the policy cast that wraps model
+    apply — float inputs enter the model in the policy's compute dtype
+    (bf16), while the loss/CE/metric arithmetic around the apply stays
+    f32 (make_ce_fn casts logits up before the softmax) and the
+    gradients/optimizer update run on the f32 masters."""
     if ce_fn is None:
         ce_fn = make_ce_fn(label_smoothing)
     if value_and_grad_fn is not None and grad_accum_steps > 1:
@@ -157,6 +165,12 @@ def make_train_step(schedule: Callable, weight_decay: float,
 
     def loss_fn(params, batch_stats, images, labels, apply_fn):
         variables = {"params": params, "batch_stats": batch_stats}
+        if precision is not None:
+            # the policy cast wraps model apply (parallel/precision.py):
+            # activations enter in the compute dtype; params stay f32
+            # masters (flax casts them per-op, and the cast's transpose
+            # re-accumulates the gradient into the f32 cotangent)
+            images = precision.cast_compute(images)
         logits, mutated = apply_fn(variables, images, train=True,
                                    mutable=["batch_stats", "losses"])
         ce = ce_fn(logits, labels)
@@ -269,7 +283,8 @@ def make_eval_step(prep_fn: Optional[Callable] = None):
     return eval_step
 
 
-def make_predict_step(prep_fn: Optional[Callable] = None):
+def make_predict_step(prep_fn: Optional[Callable] = None,
+                      precision=None, apply_fn: Optional[Callable] = None):
     """predict_step(state, batch) -> float32 logits — the SERVING forward
     (serve/): eval's forward pass without the metric reduction, so the
     dynamic batcher can slice per-request rows out of one bucket dispatch.
@@ -280,14 +295,25 @@ def make_predict_step(prep_fn: Optional[Callable] = None):
 
     ``prep_fn`` is the SAME device-side input prep the eval step uses
     (make_eval_step) — the serve path must agree with eval about who
-    standardizes or requests would be double-/un-normalized."""
+    standardizes or requests would be double-/un-normalized.
+
+    ``precision`` applies the policy input cast AFTER prep (prep
+    standardizes in f32, the model computes in the policy dtype); logits
+    always leave f32. ``apply_fn`` overrides ``state.apply_fn`` — the
+    serving reduced-precision VARIANT's apply
+    (Trainer.make_variant_predict_step builds a same-architecture model
+    with a different compute dtype), so one TrainState layout serves
+    every variant."""
 
     def predict_step(state: TrainState, batch):
         variables = {"params": state.params, "batch_stats": state.batch_stats}
         images = batch["images"]
         if prep_fn is not None:
             images = prep_fn(images)
-        logits = state.apply_fn(variables, images, train=False)
+        if precision is not None:
+            images = precision.cast_compute(images)
+        fn = apply_fn if apply_fn is not None else state.apply_fn
+        logits = fn(variables, images, train=False)
         return logits.astype(jnp.float32)
 
     return predict_step
@@ -305,15 +331,36 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else create_mesh(cfg.mesh)
         from ..models import create_model
+        # mixed-precision policy (parallel/precision.py; docs/precision.md):
+        # resolved FIRST because it overrides the model's compute dtype —
+        # train.precision=off keeps the legacy model.compute_dtype
+        # contract BIT-identical (no policy code on that path)
+        from ..parallel.precision import precision_stats, resolve_precision
+        self._precision = resolve_precision(cfg)
         # bucketed gradient-communication overlap (parallel/overlap.py):
         # resolved BEFORE the model build because the shard_map'd step
         # computes per-shard BN moments — the model must pmean them over
         # the batch axes (GroupedBatchNorm axis_name) to keep the
         # cross-replica-BN numerics. comm.overlap=on raises here when the
         # (model, mesh, train) combination is outside the envelope.
-        from ..parallel.overlap import BATCH_AXES, resolve_overlap
+        from ..parallel.overlap import (BATCH_AXES, compress_dtype,
+                                        resolve_overlap)
         self._overlap = resolve_overlap(cfg, self.mesh)
         bn_axis_name = BATCH_AXES if self._overlap is not None else None
+        # compressed gradient exchange (comm.compress) rides the bucketed
+        # overlap — validate the knob even when the exchange is off, and
+        # warn LOUDLY when compression was requested but nothing will
+        # compress (the echo_transfer-warning contract: a silently
+        # unbucketed run would never halve a byte)
+        requested_compress = compress_dtype(cfg)
+        if requested_compress is not None and self._overlap is None:
+            import logging
+            logging.getLogger(__name__).warning(
+                "comm.compress=%s with comm.overlap resolved OFF: "
+                "compression rides the bucketed gradient exchange "
+                "(parallel/overlap.py), so this run exchanges FULL f32 "
+                "payloads — enable comm.overlap (or accept the "
+                "uncompressed exchange)", cfg.comm.compress)
         # ZeRO-1 sharded weight update (arXiv:2004.13336; parallel/
         # sharding.py rule table): optimizer state shards over `data`,
         # gradients reduce-scatter into the shard layout, the update runs
@@ -362,10 +409,20 @@ class Trainer:
             # MoE, models/pipeline.py _moe_mlp) and, since round 5, seq
             # (ring attention inside the stage blocks) — no remaining
             # pairwise rejection on the pipeline axis.
+        # model-resolution choices saved for the serving variant builder
+        # (make_variant_predict_step): a variant must differ ONLY in
+        # compute dtype, never in BN wiring or remat
+        self._bn_axis_name = bn_axis_name
+        self._bn_groups = bn_groups
         self.model = create_model(cfg.model, cfg.data.dataset,
                                   axis_name=bn_axis_name,
                                   remat=cfg.train.remat, bn_groups=bn_groups,
-                                  mesh=self.mesh)
+                                  mesh=self.mesh,
+                                  compute_dtype=self._precision.compute_dtype
+                                  if self._precision is not None else None)
+        precision_stats.record_policy(
+            self._precision,
+            self._overlap.compress if self._overlap is not None else None)
         self.schedule = create_schedule(cfg.optimizer)
         decay_in_loss = not decoupled_decay(cfg.optimizer.name)
         if cfg.optimizer.decay_all_params and not decay_in_loss:
@@ -438,7 +495,8 @@ class Trainer:
         self._eval_step = make_eval_step(eval_prep)
         # serving forward (serve/; elaborated per bucket by
         # analysis/elaborate.py): same prep contract as the eval step
-        self._predict_step = make_predict_step(eval_prep)
+        self._predict_step = make_predict_step(eval_prep,
+                                               precision=self._precision)
         self._jitted_train = None
         self._jitted_multi = None
         self._jitted_eval = None
@@ -568,7 +626,8 @@ class Trainer:
                 fused_xent=cfg.train.fused_xent,
                 aux_loss_weight=cfg.model.moe_aux_weight,
                 zero1_min_size=self._zero1_min_size()
-                if self._zero1 else None)
+                if self._zero1 else None,
+                precision=self._precision)
         return make_train_step(
             self.schedule, cfg.optimizer.weight_decay,
             cfg.optimizer.label_smoothing,
@@ -581,7 +640,8 @@ class Trainer:
             aux_loss_weight=cfg.model.moe_aux_weight,
             value_and_grad_fn=vag,
             apply_gradients_fn=self._make_zero1_apply()
-            if self._zero1 else None)
+            if self._zero1 else None,
+            precision=self._precision)
 
     @property
     def comm_overlap_active(self) -> bool:
@@ -595,6 +655,36 @@ class Trainer:
         over the ``data`` axis (parallel/sharding.py ZeRO-1 rule table)."""
         return self._zero1
 
+    @property
+    def precision_active(self) -> bool:
+        """True when a mixed-precision policy (train.precision) shapes
+        the step: bf16 compute over f32 masters
+        (parallel/precision.py)."""
+        return self._precision is not None
+
+    @property
+    def comm_compress_active(self) -> bool:
+        """True when the gradient exchange actually compresses its
+        payloads (comm.compress riding an active bucketed overlap)."""
+        return self._overlap is not None and \
+            self._overlap.compress is not None
+
+    def make_variant_predict_step(self, compute_dtype):
+        """The serving VARIANT forward (serve/compile_cache.py buckets
+        are (batch, variant)): a predict step whose model computes in
+        ``compute_dtype``, sharing every other model-resolution choice
+        with this Trainer (BN axis/groups, remat, prep contract) so the
+        variant differs only in precision. The caller supplies the
+        matching (cast) TrainState — the step uses its own apply, not
+        ``state.apply_fn``."""
+        from ..models import create_model
+        model = create_model(self.cfg.model, self.cfg.data.dataset,
+                             axis_name=self._bn_axis_name,
+                             remat=self.cfg.train.remat,
+                             bn_groups=self._bn_groups, mesh=self.mesh,
+                             compute_dtype=compute_dtype)
+        return make_predict_step(self._eval_prep, apply_fn=model.apply)
+
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:
         rng = jax.random.PRNGKey(self.cfg.train.seed if seed is None else seed)
@@ -607,6 +697,15 @@ class Trainer:
         self.state = create_train_state(
             rng, self.model, self.tx, shape, mesh=self.mesh,
             zero1=self._zero1, zero1_min_size=self._zero1_min_size())
+        if self._precision is not None:
+            # the policy's checkpoint contract: f32 MASTERS only — a cast
+            # param leaf here would bake the compute dtype into every
+            # checkpoint this run writes (parallel/precision.py)
+            from ..parallel.precision import (check_master_dtypes,
+                                              precision_stats)
+            check_master_dtypes(self.state.params,
+                                self._precision.master_dtype)
+            precision_stats.record_params(self.state.params)
         return self.state
 
     # -- jitted steps ------------------------------------------------------

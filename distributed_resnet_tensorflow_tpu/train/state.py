@@ -4,6 +4,14 @@ Successor of the reference's implicit graph-collection state — TF global
 variables, BN moving averages updated via UPDATE_OPS control deps (reference
 resnet_model.py:118-121), optimizer slots on the parameter servers. Here it
 is one explicit pytree, shardable leaf-by-leaf via NamedSharding.
+
+Precision contract (parallel/precision.py; docs/precision.md): every
+float leaf of this state — params, BN stats, optimizer moments — is an
+f32 MASTER regardless of the ``train.precision`` policy. The bf16 policy
+lives entirely in the APPLY (the model's compute dtype casts masters
+per-op; the cast's transpose re-accumulates gradients into f32), so
+checkpoints, restores and the serving hot swap never see a cast leaf —
+``Trainer.init_state`` guards this with ``check_master_dtypes``.
 """
 from __future__ import annotations
 

@@ -261,6 +261,19 @@ class TrainConfig:
     # reference's fused TF op, resnet_model.py:78-80):
     # auto = on iff TPU | on | interpret (CPU tests) | off
     fused_xent: str = "auto"
+    # -- mixed-precision training policy (parallel/precision.py;
+    # docs/precision.md) ------------------------------------------------
+    # "bf16": activations/matmuls compute in bfloat16 with float32 MASTER
+    # weights and f32 BN-moment/softmax/loss accumulations — the model is
+    # built with a bf16 compute dtype (overriding model.compute_dtype;
+    # the policy cast wraps model apply), gradients and the whole
+    # optimizer update stay f32, and checkpoints always persist the f32
+    # masters so save/restore and serve hot-swap are policy-agnostic.
+    # "off" (default): the legacy model.compute_dtype contract, BIT-
+    # identical to the pre-policy step — the exactness oracle the cast
+    # path is pinned against. fp16 is refused here (needs loss scaling;
+    # see comm.compress for the fp16 exchange payload).
+    precision: str = "off"            # off | bf16
     # print MFU in the logging hook (XLA cost-analysis FLOPs / peak)
     log_mfu: bool = False
 
@@ -314,6 +327,16 @@ class CommConfig:
     # issue. Smaller buckets start communicating earlier but amortize less
     # per-collective overhead (the DDP knob, arXiv:1711.00705 §4)
     bucket_mb: float = 4.0
+    # compressed gradient exchange (docs/precision.md): cast each bucket's
+    # psum / reduce-scatter payload (and the ZeRO-1 param-update
+    # all-gather) to this dtype on the wire, re-materializing f32 on
+    # arrival — halves (bf16/fp16) the inter-host bytes the overlap
+    # machinery must hide, on the SAME bucket plan (arXiv:1811.05233:
+    # ImageNet/RN50 to reference accuracy with half-precision allreduce).
+    # Rides the bucketed exchange: with comm.overlap resolved off nothing
+    # compresses (the Trainer warns loudly). Local gradient accumulation
+    # and the optimizer update stay f32 either way.
+    compress: str = "off"             # off | bf16 | fp16
 
 
 @dataclass
@@ -478,6 +501,15 @@ class ServeConfig:
     # landed or this many extra seconds pass — scripts/serve_smoke.sh's
     # determinism knob; 0 = exit right after the load
     wait_for_swap_secs: float = 0.0
+    # reduced-precision serving variants (docs/precision.md): compile-
+    # cache buckets become (batch, variant) and every listed variant gets
+    # its own weight copy + AOT programs — "bf16" serves from bf16-cast
+    # weights through a bf16-compute predict step (about half the weight
+    # HBM and MXU-rate matmuls per replica). The FIRST entry is the
+    # default a variant-less request is served from; hot swaps rebuild
+    # every variant from the new f32 masters. Checkpoints are untouched
+    # (serving casts at apply time, never at rest).
+    variants: Tuple[str, ...] = ("f32",)
 
 
 @dataclass
@@ -535,7 +567,14 @@ def _coerce(value: Any, template: Any) -> Any:
             if not value.strip():
                 return ()
             elems = [v.strip() for v in value.split(",") if v.strip()]
-            et = float if (template and isinstance(template[0], float)) else int
+            # element type follows the template's first element; string
+            # tuples (serve.variants) pass through unconverted
+            if template and isinstance(template[0], float):
+                et = float
+            elif template and isinstance(template[0], str):
+                et = str
+            else:
+                et = int
             return tuple(et(e) for e in elems)
     if isinstance(template, tuple) and isinstance(value, list):
         return tuple(value)
@@ -627,7 +666,12 @@ def _imagenet_resnet50_lars32k() -> ExperimentConfig:
         schedule="cosine", zero1="auto",
         warmup_steps=800, total_steps=3600, label_smoothing=0.1)
     cfg.train = TrainConfig(batch_size=32768, train_steps=3600,
-                            log_every_steps=10)
+                            log_every_steps=10,
+                            # the arXiv:1811.05233 recipe shape: bf16
+                            # step + half-precision gradient exchange
+                            # (docs/precision.md)
+                            precision="bf16")
+    cfg.comm.compress = "bf16"
     return cfg
 
 
@@ -660,7 +704,9 @@ def _imagenet_resnet50_lars4k() -> ExperimentConfig:
         total_steps=large_batch_steps(bs, 90), label_smoothing=0.1)
     cfg.train = TrainConfig(batch_size=bs,
                             train_steps=large_batch_steps(bs, 90),
-                            log_every_steps=20)
+                            log_every_steps=20,
+                            precision="bf16")  # arXiv:1811.05233 recipe
+    cfg.comm.compress = "bf16"
     return cfg
 
 
@@ -679,7 +725,9 @@ def _imagenet_resnet50_lamb4k() -> ExperimentConfig:
         total_steps=large_batch_steps(bs, 90), label_smoothing=0.1)
     cfg.train = TrainConfig(batch_size=bs,
                             train_steps=large_batch_steps(bs, 90),
-                            log_every_steps=20)
+                            log_every_steps=20,
+                            precision="bf16")  # arXiv:1811.05233 recipe
+    cfg.comm.compress = "bf16"
     return cfg
 
 

@@ -359,6 +359,50 @@ EVENT_SCHEMAS = {
             "bucket_leaves": "per-bucket gradient leaf counts",
             "grad_bytes": "total exchanged gradient bytes per step",
             "leaves": "gradient leaves exchanged",
+            "compress": "comm.compress payload dtype (off = f32 wire)",
+            "bucket_wire_bytes": "per-bucket bytes actually on the wire "
+                                 "(= bucket_bytes when compress is off; "
+                                 "halved under bf16/fp16 on the SAME "
+                                 "bucket plan)",
+            "wire_bytes": "total wire bytes per step exchange",
+        },
+    },
+    "precision": {
+        "emitted_by": "train/hooks.py PrecisionHook (once per resolved "
+                      "policy, like comm_overlap — a property of the "
+                      "run, not of any step)",
+        "fields": {
+            "step": "step at export time",
+            "policy": "resolved train.precision (off | bf16)",
+            "compute_dtype": "activation/matmul dtype under the policy "
+                             "(null when off)",
+            "master_dtype": "persisted parameter/optimizer dtype "
+                            "(float32 — the checkpoint contract)",
+            "compress": "effective comm.compress (off when the bucketed "
+                        "exchange resolved off — see the Trainer "
+                        "warning)",
+            "param_leaves": "parameter leaves in the master tree",
+            "master_param_bytes": "f32 master parameter bytes (what "
+                                  "checkpoints persist regardless of "
+                                  "policy)",
+        },
+    },
+    "comm_compress": {
+        "emitted_by": "train/hooks.py CommCompressHook (once per traced "
+                      "plan WHEN compression is active; silent "
+                      "otherwise)",
+        "fields": {
+            "step": "step at export time",
+            "compress": "payload dtype on the wire (bf16 | fp16)",
+            "grad_bytes": "f32 gradient bytes the exchange covers",
+            "wire_bytes": "bytes actually exchanged after the cast",
+            "bucket_wire_bytes": "per-bucket wire bytes, issue order "
+                                 "(same bucket plan as comm_overlap's "
+                                 "bucket_bytes)",
+            "wire_ratio": "wire_bytes / grad_bytes (0.5 for bf16/fp16)",
+            "gather_wire_bytes": "ZeRO-1 param-update all-gather wire "
+                                 "bytes per bucket (comm.overlap + "
+                                 "zero1 composition only)",
         },
     },
     "corrupt_record": {
@@ -437,6 +481,8 @@ EVENT_SCHEMAS = {
             "step": "checkpoint step the batch was served from",
             "bucket": "padded batch size dispatched",
             "n": "real (un-padded) requests in the batch",
+            "variant": "serving precision variant the batch ran on "
+                       "(serve.variants; docs/precision.md)",
             "queue_ms": "oldest request's queue wait before dispatch",
             "run_ms": "dispatch -> logits-on-host wall time",
         },

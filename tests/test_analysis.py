@@ -160,6 +160,45 @@ def test_stray_device_put_covers_serve_tree(tmp_path):
     assert (os.path.join(PKG, "serve", "rogue.py"), 5) in hits
 
 
+ROGUE_MODEL = '''\
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def head(x, hidden):
+    x = nn.Dense(hidden)(x)                             # line 7: no dtype
+    return jnp.matmul(x, x.T)                           # line 8: no cast
+
+
+def fine(x, w, dtype):
+    y = nn.Dense(4, dtype=dtype)(x)                     # policied: ok
+    z = jnp.einsum("ij,jk->ik", y, w.astype(dtype))     # visible cast: ok
+    q = jnp.dot(z, w, preferred_element_type=jnp.float32)  # pinned acc: ok
+    r = jnp.matmul(q, w)  # shardcheck: ok(unpolicied-matmul)
+    return r
+'''
+
+
+def test_unpolicied_matmul_rule(tmp_path):
+    """The precision-policy lint (analysis/rules/precision_cast.py): a
+    flax module without dtype= and a raw contraction with no visible
+    dtype decision are flagged in models/ (file:line); dtype'd /
+    preferred_element_type'd / .astype'd / suppressed sites and code
+    OUTSIDE models|ops are not."""
+    models = tmp_path / PKG / "models"
+    models.mkdir(parents=True)
+    (models / "rogue.py").write_text(ROGUE_MODEL)
+    # the identical code outside the models/ops hot path: out of scope
+    (tmp_path / PKG / "elsewhere.py").write_text(ROGUE_MODEL)
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    hits = {(f.path, f.line) for f in by_rule.get("unpolicied-matmul", ())}
+    rogue = os.path.join(PKG, "models", "rogue.py")
+    assert (rogue, 7) in hits
+    assert (rogue, 8) in hits
+    assert hits == {(rogue, 7), (rogue, 8)}, hits
+
+
 def test_syntax_error_is_a_finding(tmp_path):
     pkg = tmp_path / PKG
     pkg.mkdir()
@@ -316,7 +355,7 @@ def test_elaborator_traces_serve_step_per_bucket(devices, monkeypatch):
     from distributed_resnet_tensorflow_tpu.utils.config import (
         MeshConfig, get_preset)
 
-    def broken_predict_step(prep_fn=None):
+    def broken_predict_step(prep_fn=None, precision=None, apply_fn=None):
         def step(state, batch):
             raise ValueError("serve step fixture breakage")
         return step
