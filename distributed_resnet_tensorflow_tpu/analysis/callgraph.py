@@ -1,0 +1,209 @@
+"""Approximate static call graph over the package — hangcheck's substrate.
+
+The thread/lock contract rules (``rules/thread_dispatch.py``,
+``rules/blocking_call.py``, ``rules/chief_collective.py``,
+``rules/lock_order.py``) all need the same question answered: *starting
+from this function, which other package functions can execution reach?*
+This module builds a name-based call graph over the already-parsed
+``lint.LintContext`` ASTs, resolved conservatively:
+
+  * ``name(...)``        → a function of that name in the SAME file, else
+    the unique package-wide match (ambiguous names resolve to nothing);
+  * ``self.name(...)``   → the enclosing class's method of that name,
+    else the unique package-wide match;
+  * ``obj.name(...)``    → the unique package-wide match only.
+
+Unresolvable calls (callbacks, ``getattr``, iterator protocols, lambdas
+passed around) contribute NO edges — hangcheck is deliberately an
+UNDER-approximation: a finding means a concrete static path exists, and
+a clean pass means "no path the resolver can see", not a proof. Nested
+functions/closures are reachable from their enclosing function (defining
+a worker body counts as reaching it — that is exactly how the threaded
+input stages hand work around), and generator bodies are treated as
+ordinary functions (iteration runs them).
+
+The graph is built once per ``LintContext`` and memoized on it, so the
+four hangcheck rules share one construction.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+PACKAGE = "distributed_resnet_tensorflow_tpu"
+
+
+@dataclass
+class FuncNode:
+    """One function/method definition (nested functions included)."""
+
+    rel: str                 # repo-relative file path
+    qualname: str            # e.g. "Trainer.train", "outer.<locals>.inner"
+    name: str                # bare name
+    lineno: int
+    node: ast.AST            # the FunctionDef/AsyncFunctionDef
+    cls: Optional[str] = None        # innermost enclosing class name
+    nested: List["FuncKey"] = field(default_factory=list)
+
+    @property
+    def key(self) -> "FuncKey":
+        return (self.rel, self.qualname)
+
+    def short(self) -> str:
+        """Package-relative display id, e.g. ``serve/batcher.py::DynamicBatcher._run``."""
+        rel = self.rel
+        prefix = PACKAGE + "/"
+        if rel.startswith(prefix):
+            rel = rel[len(prefix):]
+        return f"{rel}::{self.qualname}"
+
+
+FuncKey = Tuple[str, str]  # (rel, qualname)
+
+#: method names so common on stdlib containers/files/threads that a
+#: unique package-wide match on an arbitrary receiver is almost surely a
+#: COLLISION, not a call (``self._compiled.get(key)`` is ``dict.get``,
+#: not ``ServeCompileCache.get``). The fallback resolver never matches
+#: these; ``self.<name>()`` with a known enclosing class still resolves
+#: precisely through the class index.
+GENERIC_ATTRS = frozenset({
+    "get", "put", "add", "clear", "flush", "close", "open", "join",
+    "wait", "start", "stop", "run", "append", "appendleft", "pop",
+    "popleft", "update", "copy", "remove", "extend", "insert", "sort",
+    "write", "read", "send", "recv", "submit", "result", "acquire",
+    "release", "items", "keys", "values", "count", "index", "setdefault",
+})
+
+
+def body_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's OWN body: descends into everything except nested
+    function/class definitions (their statements belong to their own
+    nodes; the nesting edge keeps them reachable). Lambdas are walked —
+    they execute in the enclosing frame for our purposes."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def call_target(call: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(bare-name, self-attr-or-None) of a call's target: ``f(...)`` →
+    ("f", None); ``self.m(...)`` → ("m", "self"); ``obj.m(...)`` →
+    ("m", "obj"/None-ish receiver name)."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id, None
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value.id if isinstance(fn.value, ast.Name) else ""
+        return fn.attr, recv
+    return None, None
+
+
+class CallGraph:
+    """Name-resolved call graph over a set of parsed SourceFiles."""
+
+    def __init__(self, files):
+        self.funcs: Dict[FuncKey, FuncNode] = {}
+        self.by_name: Dict[str, List[FuncNode]] = {}
+        self.by_file_name: Dict[Tuple[str, str], List[FuncNode]] = {}
+        self.by_class_method: Dict[Tuple[str, str], List[FuncNode]] = {}
+        self._files = [sf for sf in files if sf.tree is not None]
+        for sf in self._files:
+            self._index_file(sf)
+        self._edges: Dict[FuncKey, List[FuncKey]] = {}
+        self._reach_memo: Dict[FuncKey, Set[FuncKey]] = {}
+
+    # -- construction -------------------------------------------------------
+    def _index_file(self, sf) -> None:
+        def visit(node, qual: List[str], cls: Optional[str],
+                  parent: Optional[FuncNode]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = qual + [child.name]
+                    fn = FuncNode(rel=sf.rel, qualname=".".join(q),
+                                  name=child.name, lineno=child.lineno,
+                                  node=child, cls=cls)
+                    self.funcs[fn.key] = fn
+                    self.by_name.setdefault(child.name, []).append(fn)
+                    self.by_file_name.setdefault(
+                        (sf.rel, child.name), []).append(fn)
+                    if cls is not None:
+                        self.by_class_method.setdefault(
+                            (cls, child.name), []).append(fn)
+                    if parent is not None:
+                        parent.nested.append(fn.key)
+                    visit(child, q + ["<locals>"], cls, fn)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, qual + [child.name], child.name, parent)
+                else:
+                    visit(child, qual, cls, parent)
+
+        visit(sf.tree, [], None, None)
+
+    # -- resolution ---------------------------------------------------------
+    def resolve_name(self, name: str, rel: str) -> List[FuncNode]:
+        """A bare-name callable reference: same file first, then the
+        unique package-wide match."""
+        local = [f for f in self.by_file_name.get((rel, name), ())]
+        if local:
+            return local
+        cands = self.by_name.get(name, [])
+        return cands if len(cands) == 1 else []
+
+    def resolve_call(self, call: ast.Call,
+                     caller: FuncNode) -> List[FuncNode]:
+        name, recv = call_target(call)
+        if name is None:
+            return []
+        if recv is None:
+            return self.resolve_name(name, caller.rel)
+        if recv == "self" and caller.cls is not None:
+            own = self.by_class_method.get((caller.cls, name))
+            if own:
+                return list(own)
+        if name in GENERIC_ATTRS:
+            return []  # collision-prone names never fallback-resolve
+        cands = self.by_name.get(name, [])
+        return cands if len(cands) == 1 else []
+
+    # -- reachability -------------------------------------------------------
+    def edges(self, key: FuncKey) -> List[FuncKey]:
+        cached = self._edges.get(key)
+        if cached is not None:
+            return cached
+        fn = self.funcs.get(key)
+        out: List[FuncKey] = []
+        if fn is not None:
+            out.extend(fn.nested)  # defining a closure reaches its body
+            for node in body_walk(fn.node):
+                if isinstance(node, ast.Call):
+                    out.extend(c.key for c in self.resolve_call(node, fn))
+        self._edges[key] = out
+        return out
+
+    def reachable(self, roots) -> Set[FuncKey]:
+        """Every FuncKey reachable from the given root keys (inclusive)."""
+        seen: Set[FuncKey] = set()
+        stack = [r for r in roots]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            stack.extend(self.edges(key))
+        return seen
+
+
+def get_callgraph(ctx) -> CallGraph:
+    """The shared per-LintContext graph (built once, memoized on ctx)."""
+    graph = getattr(ctx, "_hangcheck_callgraph", None)
+    if graph is None:
+        graph = CallGraph(ctx.all_python())
+        ctx._hangcheck_callgraph = graph
+    return graph

@@ -36,6 +36,16 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--no-zero1-sweep", action="store_true",
                    help="skip the 64/256-device ZeRO-1 big-mesh sweep "
                         "(elab-zero1)")
+    p.add_argument("--no-hangcheck", action="store_true",
+                   help="skip the hangcheck phases (ISSUE 13): the "
+                        "collective-schedule extraction and the "
+                        "thread/lock contract rules (cross-thread-"
+                        "dispatch, untimed-blocking-call, chief-gated-"
+                        "collective, lock-order-cycle); elaborate then "
+                        "re-owns the overlap/compress step traces")
+    p.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    # --root scopes the LINT pass to another tree (tests of the exit-code
+    # contract run the real CLI over a known-bad fixture repo)
     p.add_argument("-v", "--verbose", action="store_true",
                    help="print finding detail (full tracebacks)")
     ns = p.parse_args(argv)
@@ -56,14 +66,21 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
         apply_virtual_cpu(n_virtual)
     if not ns.elaborate_only:
         from .lint import run_lint
-        findings += run_lint()
+        rule_names = None
+        if ns.no_hangcheck:
+            from . import rules as rules_pkg
+            hang = {m.RULE_NAME for m in rules_pkg.HANGCHECK_RULES}
+            rule_names = [m.RULE_NAME for m in rules_pkg.ALL_RULES
+                          if m.RULE_NAME not in hang]
+        findings += run_lint(root=ns.root, rule_names=rule_names)
         print(f"lint: {len(findings)} finding(s) "
               f"[{time.perf_counter() - t0:.1f}s]")
     if not ns.lint_only:
         from .elaborate import run_elaborate
         t1 = time.perf_counter()
         presets = ns.preset or None  # None = all
-        efs = run_elaborate(presets, n_devices=ns.devices)
+        efs = run_elaborate(presets, n_devices=ns.devices,
+                            trace_comm_variants=ns.no_hangcheck)
         print(f"elaborate: {len(efs)} finding(s) "
               f"[{time.perf_counter() - t1:.1f}s]")
         findings += efs
@@ -74,6 +91,27 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
             print(f"elab-zero1 (64/256-device sweep): {len(zfs)} "
                   f"finding(s) [{time.perf_counter() - t2:.1f}s]")
             findings += zfs
+        if not ns.no_hangcheck:
+            # hangcheck-schedule (docs/static_analysis.md): collective
+            # schedules extracted from the traced jaxprs, determinism +
+            # declared-bucket-plan cross-checks, reviewable artifact.
+            # This phase OWNS the overlap/compress step traces while it
+            # runs (trace_comm_variants=False above) — same trace, more
+            # signal.
+            from .collectives import run_collectives, write_artifact
+            t3 = time.perf_counter()
+            cfs, sigs = run_collectives(presets, n_devices=ns.devices)
+            print(f"hangcheck-schedule: {len(cfs)} finding(s), "
+                  f"{len(sigs)} signature(s) "
+                  f"[{time.perf_counter() - t3:.1f}s]")
+            findings += cfs
+            if presets is None and ns.root is None and ns.devices == 8:
+                # full sweeps at the canonical 8-device mesh refresh the
+                # committed artifact — a partial run must not shrink it,
+                # and a --devices override changes layouts/payload bytes
+                # (the artifact diff must only ever mean a comm change)
+                path = write_artifact(sigs)
+                print(f"hangcheck-schedule: wrote {path}")
 
     from .report import format_findings
     print(format_findings(findings, verbose=ns.verbose))

@@ -1,0 +1,468 @@
+"""Collective-schedule extraction: pin the comm program statically.
+
+The collective *schedule* — which collectives a step issues, in what
+order, over which axes, with how many wire bytes — is a real program
+property now that the exchange is explicit (bucketed overlap, ZeRO-1
+scatter/gather, compressed payloads, pipeline ppermute chains): a
+reordering or a silently-merged bucket is a perf regression at best and
+a cross-host deadlock at worst (two hosts issuing collectives in
+different orders is the hang class the watchdog can only kill). This
+phase walks the jaxprs of the already-elaborated step variants and:
+
+  * emits an ordered signature of collective ops (kind, axis names,
+    operand count, payload bytes) per preset × layout × variant. Bytes
+    are PER-PARTICIPANT payloads (inside shard_map the traced avals are
+    the local shards), and the traced dtype makes compressed payloads
+    show their true wire bytes;
+  * asserts the signature is DETERMINISTIC across two elaborations for
+    every variant that carries collectives (a schedule that differs
+    between traces would differ between hosts);
+  * cross-checks the overlap variants against the DECLARED bucket plan
+    exported by ``parallel/overlap.py`` (``overlap_stats`` →
+    ``declared_collectives``): reverse-param-order bucket psums,
+    reduce-scatter-before-psum for fsdp/ZeRO leaves, one tuple-psum per
+    replicated group — the traced order must contain the declared
+    sequence in order, or the gate fails;
+  * dumps everything as ``analysis/collective_schedules.json`` (inside
+    the package, committed) — byte-identical across runs, so any PR that
+    changes comm behavior shows a reviewable diff.
+
+Variants per preset (deduped across presets sharing the program, the
+``trace_forward`` lesson): the plain jit train step (its jaxpr-level
+schedule is EMPTY for the batch-parallel families by construction — the
+exchange is left to XLA sharding propagation; non-empty is itself
+information the artifact records), the shard_map'd overlap body on every
+in-envelope layout, the ZeRO-1 scatter/gather composition, the bf16 +
+compressed-exchange composition, the pipeline/tensor/expert layouts of
+the transformer family, and the serve/predict step (smallest + largest
+AOT bucket).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .report import Finding
+
+RULE = "hangcheck-schedule"
+
+#: the preset whose dp_fsdp overlap variant is double-traced as the
+#: in-run determinism probe (cheapest in-envelope conv program)
+_DET_PROBE = "cifar10_resnet50"
+
+#: jaxpr primitive name → normalized op kind. ``psum2`` is the
+#: shard_map-era spelling of psum; ``reduce_scatter`` implements
+#: ``lax.psum_scatter``. ``pbroadcast`` is a replication-rule adjustment,
+#: not a wire collective — deliberately excluded.
+WIRE_PRIMS = {
+    "psum": "psum",
+    "psum2": "psum",
+    "reduce_scatter": "psum_scatter",
+    "all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+    "pmax": "pmax",
+    "pmin": "pmin",
+    "pgather": "pgather",
+}
+
+
+def _axes_of(eqn) -> Tuple[str, ...]:
+    axes = eqn.params.get("axes", None)
+    if axes is None:
+        axes = eqn.params.get("axis_name", ())
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+def _payload_bytes(eqn) -> int:
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", None)
+        dtype = getattr(aval, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(math.prod(shape)) * np.dtype(dtype).itemsize
+    return total
+
+
+def _sub_jaxprs(eqn):
+    # duck-typed (stable across jax releases): a ClosedJaxpr carries
+    # .jaxpr, a raw Jaxpr carries .eqns; params may hold either, alone
+    # or in tuples (scan bodies, cond branches, shard_map/pjit/remat)
+    for val in eqn.params.values():
+        stack = [val]
+        while stack:
+            item = stack.pop()
+            name = type(item).__name__
+            if name == "ClosedJaxpr":
+                yield item.jaxpr
+            elif name == "Jaxpr":
+                yield item
+            elif isinstance(item, (list, tuple)):
+                stack.extend(item)
+
+
+def collect_ops(jaxpr) -> List[dict]:
+    """Ordered collective signature of a jaxpr (recursing into shard_map
+    / pjit / scan / cond / remat sub-jaxprs in eqn order). Loop bodies
+    (scan/while) contribute their body's schedule ONCE — the static
+    issue order, not the dynamic repetition count."""
+    out: List[dict] = []
+    for eqn in jaxpr.eqns:
+        kind = WIRE_PRIMS.get(eqn.primitive.name)
+        if kind is not None:
+            out.append({
+                "op": kind,
+                "axes": list(_axes_of(eqn)),
+                "operands": len(eqn.invars),
+                "bytes": _payload_bytes(eqn),
+            })
+        for sub in _sub_jaxprs(eqn):
+            out.extend(collect_ops(sub))
+    return out
+
+
+def extract_schedule(fn, *abstract_args) -> List[dict]:
+    """Trace ``fn`` abstractly (zero compute) and return its ordered
+    collective signature."""
+    import jax
+    return collect_ops(jax.make_jaxpr(fn)(*abstract_args).jaxpr)
+
+
+def _op_sig(op: dict) -> str:
+    return f"{op['op']}@" + "+".join(op["axes"])
+
+
+def check_declared_plan(schedule: Sequence[dict],
+                        declared: Sequence[Sequence[str]],
+                        locus: str) -> List[Finding]:
+    """The declared per-bucket collective sequences must appear, in
+    order, within the traced schedule (the trace additionally carries
+    the forward fsdp all-gathers and the loss/metric psums around the
+    exchange — subsequence matching, not equality)."""
+    flat_declared = [sig for bucket in declared for sig in bucket]
+    traced = [_op_sig(op) for op in schedule]
+    it = iter(traced)
+    missing = [sig for sig in flat_declared
+               if not any(t == sig for t in it)]
+    if missing:
+        return [Finding(
+            RULE, locus, 0,
+            f"traced collective schedule does not contain the declared "
+            f"bucket plan in order — first missing {missing[0]!r} "
+            f"(declared {len(flat_declared)} exchange ops over "
+            f"{len(declared)} buckets; traced {traced})")]
+    return []
+
+
+def _schedule_key(name: str, layout: str, variant: str) -> str:
+    return f"{name}@{layout}/{variant}"
+
+
+def _trainer_for(cfg, mesh):
+    from ..train.loop import Trainer
+    return Trainer(cfg, mesh=mesh)
+
+
+#: abstract-state memo across variants/presets: state SHAPES depend only
+#: on (model, optimizer family, input dims, batch shards) — rebuilding
+#: them per traced variant would be the phase's largest fixed cost
+_STATE_MEMO: dict = {}
+
+
+def _abstract_state(trainer, cfg):
+    import dataclasses
+    from ..train.state import abstract_train_state
+    from ..parallel.mesh import batch_shard_count
+    nb = batch_shard_count(trainer.mesh)
+    key = repr((dataclasses.asdict(cfg.model), cfg.optimizer.name,
+                cfg.data.dataset, cfg.data.image_size, nb))
+    state = _STATE_MEMO.get(key)
+    if state is None:
+        state = abstract_train_state(
+            trainer.model, trainer.tx,
+            (nb, cfg.data.image_size, cfg.data.image_size, 3)
+            if cfg.model.name != "logistic"
+            else (nb, cfg.model.input_size))
+        _STATE_MEMO[key] = state
+    return state
+
+
+def run_collectives(preset_names: Optional[Sequence[str]] = None,
+                    n_devices: int = 8
+                    ) -> Tuple[List[Finding], Dict[str, dict]]:
+    """The hangcheck-schedule phase: (findings, signatures). Signatures
+    feed ``analysis/collective_schedules.json`` (written by the check
+    CLI on full-sweep runs)."""
+    import copy
+    import dataclasses
+    import jax
+    from ..parallel.mesh import create_mesh
+    from ..parallel.overlap import (overlap_stats,
+                                    overlap_unsupported_reason)
+    from ..utils.config import PRESETS, get_preset
+    from .elaborate import candidate_layouts, _abstract_batch, \
+        _axis_product
+
+    findings: List[Finding] = []
+    signatures: Dict[str, dict] = {}
+    if len(jax.devices()) < n_devices:
+        return ([Finding(RULE, "environment", 0,
+                         f"{len(jax.devices())} devices present, "
+                         f"{n_devices} needed")], signatures)
+
+    seen_programs: set = set()
+
+    def dedupe(kind: str, cfg, layout: str, extra=()) -> bool:
+        """True when this (program, layout) was already traced under
+        another preset name (the schedule would be identical)."""
+        key = repr((kind, dataclasses.asdict(cfg.model), cfg.data.dataset,
+                    cfg.data.image_size, layout, tuple(extra)))
+        if key in seen_programs:
+            return True
+        seen_programs.add(key)
+        return False
+
+    def record(name: str, layout: str, variant: str, builder,
+               deterministic_retrace: bool, plan_check: bool) -> None:
+        """Trace (maybe twice), cross-check, record the signature."""
+        locus = _schedule_key(name, layout, variant)
+        try:
+            if plan_check:
+                overlap_stats.reset()
+            schedule = builder()
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}".splitlines()[0][:300]
+            findings.append(Finding(RULE, locus, 0,
+                                    f"schedule trace failed: {msg}",
+                                    detail=str(e)[:4000]))
+            return
+        entry: dict = {"ops": schedule}
+        if plan_check:
+            snap = overlap_stats.snapshot()
+            if snap is None or not snap.get("declared_collectives"):
+                findings.append(Finding(
+                    RULE, locus, 0,
+                    "overlap variant traced but parallel/overlap.py "
+                    "recorded no declared bucket plan — the exchange "
+                    "did not run through make_bucketed_grad"))
+            else:
+                findings.extend(check_declared_plan(
+                    schedule, snap["declared_collectives"], locus))
+                entry["plan"] = {
+                    "buckets": snap["buckets"],
+                    "bucket_bytes": snap["bucket_bytes"],
+                    "bucket_wire_bytes": snap["bucket_wire_bytes"],
+                    "compress": snap["compress"],
+                    "declared_collectives": snap["declared_collectives"],
+                }
+        if deterministic_retrace and schedule:
+            second = builder()
+            if second != schedule:
+                findings.append(Finding(
+                    RULE, locus, 0,
+                    "collective schedule is NOT deterministic across two "
+                    "elaborations — hosts tracing independently could "
+                    "issue different orders (first diff at op "
+                    f"{next(i for i, (a, b) in enumerate(zip(schedule, second)) if a != b) if len(second) == len(schedule) else 'count'})"))
+        signatures[locus] = entry
+
+    for name in (preset_names or sorted(PRESETS)):
+        cfg = get_preset(name)
+        layouts = candidate_layouts(cfg, n_devices)
+        traced_plain = False
+        # the low-precision composition (variant 3 below) prefers dp_fsdp
+        # (both batch axes live) but must not vanish for a family whose
+        # only in-envelope layout is dp — elaborate's elab-precision-step
+        # traced it on the first supported layout before hangcheck took
+        # the comm traces over (trace_comm_variants=False)
+        compress_label = None
+        if cfg.train.precision == "off":
+            for _lbl, _mc in layouts:
+                try:
+                    _m = create_mesh(_mc, devices=jax.devices()
+                                     [:_axis_product(_mc)])
+                except Exception:
+                    continue
+                if overlap_unsupported_reason(cfg, _m) is None and \
+                        (compress_label is None or _lbl == "dp_fsdp"):
+                    compress_label = _lbl
+        for label, mesh_cfg in layouts:
+            n = _axis_product(mesh_cfg)
+            try:
+                mesh = create_mesh(mesh_cfg, devices=jax.devices()[:n])
+            except Exception as e:
+                findings.append(Finding(
+                    RULE, _schedule_key(name, label, "train"), 0,
+                    f"mesh build failed: {e}"))
+                continue
+            shaping = max(mesh_cfg.pipeline, 1) > 1 or \
+                max(mesh_cfg.tensor, 1) > 1 or \
+                max(mesh_cfg.expert, 1) > 1 or \
+                max(mesh_cfg.sequence, 1) > 1
+
+            # (1) plain jit train step: once per program (CNN steps don't
+            # read the mesh at trace time; shaped transformer layouts do).
+            # Batch size, optimizer and precision policy never shape the
+            # JAXPR-LEVEL collective schedule of the jit step — grads are
+            # param-shaped, the exchange is XLA propagation, the policy
+            # changes dtypes not collectives — so the optimizer/precision
+            # variants of one base preset dedupe onto it
+            if (shaping or not traced_plain) and \
+                    not dedupe("train", cfg, label if shaping else "any"):
+                traced_plain = True
+
+                def build_train(cfg=cfg, mesh=mesh):
+                    trainer = _trainer_for(copy.deepcopy(cfg), mesh)
+                    state = _abstract_state(trainer, cfg)
+                    batch = _abstract_batch(cfg, cfg.train.batch_size)
+                    return extract_schedule(trainer._train_step, state,
+                                            batch)
+
+                record(name, label, "train", build_train,
+                       deterministic_retrace=shaping, plan_check=False)
+
+            # (2) bucketed-overlap exchange, per in-envelope layout; the
+            # ZeRO-1 scatter/gather composition rides the same trace for
+            # presets that enable the knob
+            if overlap_unsupported_reason(cfg, mesh) is None:
+                zero1 = cfg.optimizer.zero1 != "off"
+                if not dedupe("overlap", cfg, label,
+                              (cfg.comm.bucket_mb, cfg.comm.compress,
+                               cfg.train.precision, zero1,
+                               cfg.optimizer.zero1_min_size)):
+
+                    def build_overlap(cfg=cfg, mesh=mesh, zero1=zero1):
+                        ocfg = copy.deepcopy(cfg)
+                        ocfg.comm.overlap = "on"
+                        if zero1:
+                            ocfg.optimizer.zero1 = "on"
+                        trainer = _trainer_for(ocfg, mesh)
+                        state = _abstract_state(trainer, cfg)
+                        batch = _abstract_batch(ocfg,
+                                                ocfg.train.batch_size)
+                        return extract_schedule(trainer._train_step,
+                                                state, batch)
+
+                    # determinism double-trace rides the cheapest
+                    # in-envelope program's dp_fsdp layout (both batch
+                    # axes live) — re-tracing EVERY variant would double
+                    # the phase for no additional signal: the machinery
+                    # under test (tree flatten order, greedy bucketing,
+                    # shard_map lowering) is shared, and cross-RUN
+                    # byte-identity of the artifact covers the rest
+                    record(name, label,
+                           "overlap+zero1" if zero1 else "overlap",
+                           build_overlap,
+                           deterministic_retrace=(label == "dp_fsdp"
+                                                  and name == _DET_PROBE),
+                           plan_check=True)
+
+                # (3) the full low-precision composition: bf16 step ×
+                # bucketed exchange × compressed payload — wire bytes in
+                # the signature come out halved because the traced
+                # operands ARE bf16. One layout (dp_fsdp exercises both
+                # batch axes) per program.
+                if label == compress_label \
+                        and not dedupe("compress", cfg, label, ()):
+
+                    def build_compress(cfg=cfg, mesh=mesh):
+                        ccfg = copy.deepcopy(cfg)
+                        ccfg.train.precision = "bf16"
+                        ccfg.comm.overlap = "on"
+                        ccfg.comm.compress = "bf16"
+                        trainer = _trainer_for(ccfg, mesh)
+                        state = _abstract_state(trainer, cfg)
+                        batch = _abstract_batch(ccfg,
+                                                ccfg.train.batch_size)
+                        return extract_schedule(trainer._train_step,
+                                                state, batch)
+
+                    record(name, label, "bf16+compress", build_compress,
+                           deterministic_retrace=False, plan_check=True)
+
+        # (4) serve/predict step: smallest + largest AOT bucket on the
+        # first layout — forward-only, so the signature pins that serving
+        # carries NO hidden collectives on the batch-parallel meshes
+        if layouts and not dedupe("serve", cfg, layouts[0][0],
+                                  (cfg.serve.max_batch,)):
+            label, mesh_cfg = layouts[0]
+            try:
+                import jax as _jax
+                mesh = create_mesh(mesh_cfg,
+                                   devices=_jax.devices()
+                                   [:_axis_product(mesh_cfg)])
+                from ..serve.compile_cache import bucket_sizes
+                from ..serve.server import serve_image_spec
+                trainer = _trainer_for(copy.deepcopy(cfg), mesh)
+                state = _abstract_state(trainer, cfg)
+                pad_to = trainer.eval_pad_multiple()
+                img_shape, img_dtype = serve_image_spec(cfg)
+                max_batch = cfg.serve.max_batch or \
+                    cfg.data.eval_batch_size
+                buckets = bucket_sizes(max_batch, pad_to)
+                # the dtype/collective story is bucket-independent; the
+                # largest bucket is the signature, the smallest rides
+                # along only for the serving workhorse preset
+                probe = sorted({buckets[-1]} | (
+                    {buckets[0]} if name == "imagenet_resnet50" else set()))
+                for bucket in probe:
+                    def build_serve(bucket=bucket, trainer=trainer,
+                                    state=state):
+                        import jax as __jax
+                        sbatch = {"images": __jax.ShapeDtypeStruct(
+                            (bucket,) + img_shape, img_dtype)}
+                        return extract_schedule(trainer._predict_step,
+                                                state, sbatch)
+                    record(name, label, f"serve_b{bucket}", build_serve,
+                           deterministic_retrace=False, plan_check=False)
+            except Exception as e:
+                findings.append(Finding(
+                    RULE, _schedule_key(name, layouts[0][0], "serve"), 0,
+                    f"serve schedule setup failed: {e}"))
+    return findings, signatures
+
+
+def _rle(ops: Sequence[dict]) -> List[dict]:
+    """Run-length-encode consecutive identical ops for the artifact (a
+    ResNet's per-BN-layer moment psums are dozens of identical 64-byte
+    entries — one ``count`` line diffs better than 50 repeats)."""
+    out: List[dict] = []
+    for op in ops:
+        if out and {k: v for k, v in out[-1].items() if k != "count"} == op:
+            out[-1]["count"] += 1
+        else:
+            out.append({**op, "count": 1})
+    return out
+
+
+def write_artifact(signatures: Dict[str, dict],
+                   path: Optional[str] = None) -> str:
+    """Dump the signature map as the committed, reviewable artifact —
+    sorted keys, fixed layout, trailing newline: byte-identical across
+    runs whenever the schedules are (which the determinism check
+    enforces)."""
+    import json
+    import os
+    if path is None:
+        path = artifact_path()
+    doc = {"schema_version": 1, "signatures": {
+        key: {**entry, "ops": _rle(entry["ops"])}
+        for key, entry in signatures.items()}}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def artifact_path() -> str:
+    import os
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "collective_schedules.json")

@@ -151,6 +151,7 @@ def check_spec_tree(state_shapes, shardings, mesh,
 def elaborate_config(cfg, mesh_cfg, locus: str,
                      trace_steps: bool = True,
                      trace_forward: bool = True,
+                     trace_comm_variants: bool = True,
                      _state_cache: Optional[dict] = None,
                      _precision_seen: Optional[set] = None) -> List[Finding]:
     """Elaborate ONE (config, mesh layout): returns findings (empty=clean).
@@ -170,7 +171,17 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
     them: the large-batch optimizer variants (lars4k/lamb4k/lars32k)
     share imagenet_resnet50's forward exactly, and re-sweeping every
     serve bucket per optimizer would triple the gate's largest cost for
-    zero coverage."""
+    zero coverage.
+
+    ``trace_comm_variants=False`` skips the comm-program traces this
+    phase shares with hangcheck's schedule extractor — the
+    ``comm.overlap=on`` step and the bf16 + compressed-exchange
+    composition. When the hangcheck-schedule phase runs (the gate's
+    default), ``analysis/collectives.py`` traces those SAME programs via
+    ``jax.make_jaxpr`` (reporting trace failures as findings with the
+    same semantics), so re-eval_shaping them here would double the
+    gate's largest cost for zero coverage; ``--no-hangcheck`` flips them
+    back on."""
     import jax
     from ..parallel.mesh import batch_shard_count, create_mesh
     from ..train.loop import Trainer
@@ -292,7 +303,8 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
         try:
             import copy
             from ..parallel.overlap import overlap_unsupported_reason
-            if overlap_unsupported_reason(cfg, mesh) is None:
+            if trace_comm_variants and \
+                    overlap_unsupported_reason(cfg, mesh) is None:
                 ocfg = copy.deepcopy(cfg)
                 ocfg.comm.overlap = "on"
                 otrainer = Trainer(ocfg, mesh=mesh)
@@ -350,9 +362,11 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
                     vbatch = {"images": jax.ShapeDtypeStruct(
                         (pad_to,) + vshape, vdtype)}
                     jax.eval_shape(vstep, vstate, vbatch)
-                if overlap_unsupported_reason(pcfg, mesh) is None:
+                if trace_comm_variants and \
+                        overlap_unsupported_reason(pcfg, mesh) is None:
                     # bf16 step × bucketed exchange × compressed payload
-                    # — the full low-precision composition
+                    # — the full low-precision composition (skipped when
+                    # hangcheck's schedule phase traces it instead)
                     ccfg = copy.deepcopy(pcfg)
                     ccfg.comm.overlap = "on"
                     ccfg.comm.compress = "bf16"
@@ -559,7 +573,8 @@ def run_elaborate_zero1(preset_names: Optional[Sequence[str]] = None,
 
 
 def run_elaborate(preset_names: Optional[Sequence[str]] = None,
-                  n_devices: int = 8) -> List[Finding]:
+                  n_devices: int = 8,
+                  trace_comm_variants: bool = True) -> List[Finding]:
     """Elaborate the named presets (default: all) across their candidate
     layouts. Call ``apply_virtual_cpu(n_devices)`` BEFORE the jax backend
     initializes (main.py's ``check`` subcommand does)."""
@@ -602,6 +617,7 @@ def run_elaborate(preset_names: Optional[Sequence[str]] = None,
                 elaborate_config(cfg, mesh_cfg, f"{name}@{label}",
                                  trace_steps=trace,
                                  trace_forward=trace and fwd,
+                                 trace_comm_variants=trace_comm_variants,
                                  _state_cache=state_cache,
                                  _precision_seen=precision_seen))
             traced = True

@@ -1,0 +1,263 @@
+"""Lock-acquisition-order analysis: find cycles before they deadlock.
+
+The process holds ~20 locks (serve batcher/swap/compile-cache, heartbeat,
+watchdog escalation, metrics writer, tracer ring, stager ring, stats
+registries). Each is individually a short leaf critical section — the
+deadlock risk is COMPOSITION: thread 1 holds lock A and calls into code
+that takes lock B while thread 2 does the reverse. That cycle is
+invisible at either site and only fires under load, as a hang the
+watchdog can merely kill.
+
+This module extracts the acquisition-order graph statically:
+
+  * lock identities from ``self._x = threading.Lock()/RLock()/Condition()``
+    assignments (→ ``module::Class._x``) and module-level
+    ``NAME = threading.Lock()`` (→ ``module::NAME``);
+  * acquisition sites from ``with <lock>:`` statements (the codebase's
+    idiom — bare ``.acquire()`` is not used);
+  * an edge A→B whenever, lexically inside a ``with A:`` body, either a
+    nested ``with B:`` appears or a call resolves (via
+    ``analysis/callgraph.py``'s conservative resolver) to a function
+    that — transitively — acquires B.
+
+``rules/lock_order.py`` fails the gate on any cycle in that graph,
+including self-cycles (re-acquiring a non-reentrant ``threading.Lock``
+deadlocks immediately). Lock identity is per CLASS attribute, not per
+instance: two instances of one class cannot be distinguished statically,
+so a reported cycle on one identity may in reality span two objects —
+that is still an ordering hazard worth a look, and a vetted exception
+carries ``# shardcheck: ok(lock-order-cycle)`` at the acquisition site.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FuncKey, FuncNode, get_callgraph
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition")
+
+
+@dataclass(frozen=True)
+class LockSite:
+    """One ``with <lock>:`` acquisition."""
+
+    lock: str          # lock identity, e.g. "serve/batcher.py::DynamicBatcher._in_lock"
+    rel: str
+    lineno: int
+    fn: FuncKey
+
+
+@dataclass
+class LockModel:
+    locks: Set[str] = field(default_factory=set)
+    sites: List[LockSite] = field(default_factory=list)
+    #: fn key -> direct acquisitions in that function's own body
+    fn_sites: Dict[FuncKey, List[Tuple[ast.With, LockSite]]] = \
+        field(default_factory=dict)
+
+
+def _short(rel: str) -> str:
+    from .callgraph import PACKAGE
+    prefix = PACKAGE + "/"
+    return rel[len(prefix):] if rel.startswith(prefix) else rel
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    name = fn.id if isinstance(fn, ast.Name) else \
+        fn.attr if isinstance(fn, ast.Attribute) else None
+    return name in _LOCK_CTORS
+
+
+def _lock_identity(expr: ast.AST, fn: FuncNode,
+                   known: Set[str]) -> Optional[str]:
+    """Map a with-item context expression onto a known lock identity."""
+    short = _short(fn.rel)
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+            and fn.cls is not None:
+        lid = f"{short}::{fn.cls}.{expr.attr}"
+        if lid in known:
+            return lid
+        # the attribute may be assigned in ANOTHER class this class wraps;
+        # fall back to a unique attr-name match across known locks
+        cands = [k for k in known if k.endswith("." + expr.attr)]
+        return cands[0] if len(cands) == 1 else None
+    if isinstance(expr, ast.Name):
+        lid = f"{short}::{expr.id}"
+        if lid in known:
+            return lid
+        cands = [k for k in known if k.split("::")[-1] == expr.id]
+        return cands[0] if len(cands) == 1 else None
+    return None
+
+
+def build_lock_model(ctx) -> LockModel:
+    graph = get_callgraph(ctx)
+    model = LockModel()
+    # pass 1: lock identities
+    for sf in ctx.all_python():
+        if sf.tree is None:
+            continue
+        short = _short(sf.rel)
+
+        def scan(node, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan(child, child.name)
+                    continue
+                if isinstance(child, ast.Assign) and \
+                        _is_lock_ctor(child.value):
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name) and cls is None:
+                            model.locks.add(f"{short}::{tgt.id}")
+                        elif isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self" and cls is not None:
+                            model.locks.add(f"{short}::{cls}.{tgt.attr}")
+                scan(child, cls)
+
+        scan(sf.tree, None)
+
+    # pass 2: acquisition sites per function
+    for key, fn in graph.funcs.items():
+        sites: List[Tuple[ast.With, LockSite]] = []
+        for node in _own_body_withs(fn.node):
+            for item in node.items:
+                lid = _lock_identity(item.context_expr, fn, model.locks)
+                if lid is not None:
+                    site = LockSite(lid, fn.rel, node.lineno, key)
+                    sites.append((node, site))
+                    model.sites.append(site)
+        if sites:
+            model.fn_sites[key] = sites
+    return model
+
+
+def _own_body_withs(fn_node) -> Iterator[ast.With]:
+    from .callgraph import body_walk
+    for node in body_walk(fn_node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            yield node
+
+
+def _acquires_closure(graph: CallGraph, model: LockModel
+                      ) -> Dict[FuncKey, Set[str]]:
+    """fn → every lock it may acquire, directly or via resolved calls."""
+    out: Dict[FuncKey, Set[str]] = {
+        key: {s.lock for _, s in sites}
+        for key, sites in model.fn_sites.items()}
+    for key in graph.funcs:
+        out.setdefault(key, set())
+    changed = True
+    while changed:
+        changed = False
+        for key in graph.funcs:
+            acc = out[key]
+            before = len(acc)
+            for callee in graph.edges(key):
+                acc |= out.get(callee, set())
+            if len(acc) != before:
+                changed = True
+    return out
+
+
+@dataclass(frozen=True)
+class LockEdge:
+    held: str
+    acquired: str
+    rel: str        # where the inner acquisition is introduced
+    lineno: int
+    via: str        # "nested with" or the call text that leads there
+
+
+def build_order_graph(ctx) -> List[LockEdge]:
+    """Every held→acquired pair the analyzer can see."""
+    graph = get_callgraph(ctx)
+    model = build_lock_model(ctx)
+    closure = _acquires_closure(graph, model)
+    edges: List[LockEdge] = []
+    seen = set()
+
+    def add(held, acquired, rel, lineno, via):
+        k = (held, acquired, rel, lineno)
+        if k not in seen:
+            seen.add(k)
+            edges.append(LockEdge(held, acquired, rel, lineno, via))
+
+    for key, sites in model.fn_sites.items():
+        fn = graph.funcs[key]
+        for with_node, site in sites:
+            # everything lexically inside this with-body
+            inner_withs = []
+            inner_calls = []
+            stack = list(with_node.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    inner_withs.append(node)
+                if isinstance(node, ast.Call):
+                    inner_calls.append(node)
+                stack.extend(ast.iter_child_nodes(node))
+            for iw in inner_withs:
+                for item in iw.items:
+                    lid = _lock_identity(item.context_expr, fn,
+                                         model.locks)
+                    if lid is not None:
+                        add(site.lock, lid, fn.rel, iw.lineno,
+                            "nested with")
+            for call in inner_calls:
+                for callee in graph.resolve_call(call, fn):
+                    for lid in sorted(closure.get(callee.key, ())):
+                        add(site.lock, lid, fn.rel, call.lineno,
+                            f"call into {callee.short()}")
+    return edges
+
+
+def find_cycles(edges: List[LockEdge]) -> List[List[LockEdge]]:
+    """Elementary cycles in the acquisition-order graph (each reported
+    once, rotated to start at the smallest lock id). Self-edges (A→A,
+    re-acquiring a non-reentrant lock) are length-1 cycles."""
+    adj: Dict[str, List[LockEdge]] = {}
+    for e in edges:
+        adj.setdefault(e.held, []).append(e)
+    cycles: List[List[LockEdge]] = []
+    seen_keys = set()
+
+    def canon(path: List[LockEdge]):
+        names = [e.held for e in path]
+        i = names.index(min(names))
+        rotated = path[i:] + path[:i]
+        return tuple((e.held, e.acquired) for e in rotated), rotated
+
+    def dfs(start: str, node: str, path: List[LockEdge],
+            on_path: Set[str]):
+        for e in sorted(adj.get(node, ()),
+                        key=lambda e: (e.acquired, e.rel, e.lineno)):
+            if e.acquired == start:
+                key, rotated = canon(path + [e])
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(rotated)
+            elif e.acquired not in on_path and e.acquired > start:
+                # only explore ids > start: each cycle found exactly once,
+                # from its smallest node
+                dfs(start, e.acquired, path + [e],
+                    on_path | {e.acquired})
+
+    for e in edges:
+        if e.held == e.acquired:
+            key = ((e.held, e.acquired),)
+            if key not in seen_keys:
+                seen_keys.add(key)
+                cycles.append([e])
+    for start in sorted({e.held for e in edges}):
+        dfs(start, start, [], {start})
+    return cycles

@@ -7,8 +7,9 @@ Adding a rule: create the module, append it to ``ALL_RULES``, add a
 known-bad fixture to tests/test_analysis.py and a row to the catalog in
 docs/static_analysis.md.
 """
-from . import (bare_assert, cached_mesh, ckpt_io, device_put, exit_codes,
-               opt_state, precision_cast, registry_drift)
+from . import (bare_assert, blocking_call, cached_mesh, chief_collective,
+               ckpt_io, device_put, exit_codes, lock_order, opt_state,
+               precision_cast, registry_drift, thread_dispatch)
 
 ALL_RULES = (
     device_put,
@@ -19,4 +20,17 @@ ALL_RULES = (
     ckpt_io,
     opt_state,
     precision_cast,
+    thread_dispatch,
+    blocking_call,
+    chief_collective,
+    lock_order,
+)
+
+#: the hangcheck thread/lock contract rules (ISSUE 13) — ``main.py check
+#: --no-hangcheck`` excludes exactly these (mirroring --no-zero1-sweep)
+HANGCHECK_RULES = (
+    thread_dispatch,
+    blocking_call,
+    chief_collective,
+    lock_order,
 )

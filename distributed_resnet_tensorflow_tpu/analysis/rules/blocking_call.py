@@ -1,0 +1,91 @@
+"""untimed-blocking-call: loop/dispatch threads never park unbounded.
+
+A ``queue.get()``, ``Event.wait()`` or ``Thread.join()`` with no timeout
+on the train-loop or serve-dispatch thread turns ANY upstream death into
+a silent permanent hang: the producer thread that crashed without
+posting its sentinel leaves the consumer parked forever, the watchdog's
+"stalled progress" verdict fires minutes later (if armed at all), and
+the job burns its allocation until the SLURM limit. Bounded waits with a
+liveness re-check turn the same failure into a loud error in seconds.
+
+The rule roots at ``analysis/threads.LOOP_ROOTS`` (the train/eval loop
+entries and the serve dispatch body) plus every spawn target registered
+with the ``dispatch`` role, walks the resolved call graph, and flags any
+reachable zero-argument ``.get()`` / ``.wait()`` / ``.join()`` (no
+``timeout=``). Zero-arg is the discriminator: ``dict.get(k)``,
+``str.join(xs)``, ``os.path.join(a, b)`` all carry arguments; the
+blocking signatures bare of arguments are the queue/event/thread forms.
+
+Regression notes (findings this rule surfaced on the real tree, fixed in
+the same round it landed):
+
+  * ``data/device_prefetch.threaded_iterator`` — the consumer's
+    ``q.get()`` was untimed; a worker thread killed without posting its
+    ``_STOP``/error sentinel (interpreter teardown, a hard crash in
+    native decode) would park the train loop forever. Now a 5 s timed
+    get that re-checks ``thread.is_alive()`` and raises loudly when the
+    worker died silently.
+  * ``data/imagenet.imagenet_iterator`` — the in-process decoder path's
+    ``out_q.get()`` had the same shape (the PROCESS path already polled
+    liveness); both paths now share the timed-get-plus-liveness idiom.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Finding
+from .. import threads as threads_mod
+from ..callgraph import call_target, body_walk, get_callgraph
+
+RULE_NAME = "untimed-blocking-call"
+DOC = __doc__
+
+_BLOCKING_ATTRS = ("get", "wait", "join")
+
+
+def _untimed_blocking(call: ast.Call) -> bool:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _BLOCKING_ATTRS:
+        return False
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return False
+    # positional timeouts: Event.wait(t) / join(t) / Queue.get(block, t).
+    # A one-positional-arg .get(x) is almost always dict.get(key) — flag
+    # it only when the arg is literally True (Queue.get(True) blocks
+    # forever exactly like bare get()); same for get(block=True).
+    if fn.attr == "get":
+        for kw in call.keywords:
+            if kw.arg == "block":
+                return isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is True
+        if call.args:
+            return len(call.args) == 1 and \
+                isinstance(call.args[0], ast.Constant) and \
+                call.args[0].value is True
+        return True
+    return not call.args
+
+
+def check(ctx) -> Iterable[Finding]:
+    graph = get_callgraph(ctx)
+    wanted = set(threads_mod.LOOP_ROOTS)
+    roots = [key for key, fn in graph.funcs.items()
+             if fn.short() in wanted]
+    for spawn in threads_mod.iter_spawn_sites(ctx):
+        if spawn.target is not None and \
+                threads_mod.role_of(spawn.target) == \
+                threads_mod.ROLE_DISPATCH:
+            roots.append(spawn.target.key)
+    for key in sorted(graph.reachable(roots)):
+        fn = graph.funcs[key]
+        for node in body_walk(fn.node):
+            if isinstance(node, ast.Call) and _untimed_blocking(node):
+                name, _ = call_target(node)
+                yield Finding(
+                    RULE_NAME, fn.rel, node.lineno,
+                    f"untimed blocking .{name}() reachable from the "
+                    "loop/dispatch thread — a dead producer parks this "
+                    "thread forever; use a timed wait that re-checks "
+                    "liveness and fails loudly "
+                    "(docs/static_analysis.md hangcheck)")

@@ -1,0 +1,63 @@
+"""chief-gated-collective: no collective runs on the chief alone.
+
+The classic SPMD divergence hang: ``if is_chief(): <something that
+issues a collective>``. Every other process never reaches the matching
+collective, the chief blocks in it forever, and the job dies as a
+watchdog timeout (exit 75 → requeue) instead of an error at the guilty
+line. PR 4's gloo hang was this family at one remove — host-side control
+flow diverging across processes in front of a collective.
+
+Statically: the rule finds chief-gated statement groups
+(``analysis/threads.chief_gated_statements`` — ``if is_chief():`` /
+``if jax.process_index() == 0:`` bodies, the same test bound to a local
+name, and the tail of a function behind an early ``if not is_chief():
+return`` guard) and flags any gated call that is collective-bearing:
+a direct lax collective (``psum``/``all_gather``/…), a multihost barrier
+(``sync_global_devices``/``process_allgather``/``broadcast_one_to_all``),
+an executed ``jitted_*`` step, or a resolved call into a function that
+transitively reaches one (``Trainer.evaluate``, ``CheckpointManager.
+save``, …).
+
+Chief-gated METRICS/file work (writers, summaries, layout stamps) is the
+codebase's norm and stays clean — only collective-bearing reachability
+fires. Deliberate single-process exceptions carry
+``# shardcheck: ok(chief-gated-collective)``.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..report import Finding
+from .. import threads as threads_mod
+from ..callgraph import call_target, get_callgraph
+
+RULE_NAME = "chief-gated-collective"
+DOC = __doc__
+
+
+def check(ctx) -> Iterable[Finding]:
+    graph = get_callgraph(ctx)
+    bearing = threads_mod.collective_bearing_keys(graph)
+    for key, fn in sorted(graph.funcs.items()):
+        for stmts in threads_mod.chief_gated_statements(fn):
+            for call in threads_mod.calls_in_statements(stmts, fn):
+                hit = None
+                if threads_mod.is_jitted_execution(call):
+                    hit = "executes a jitted step"
+                else:
+                    name, _ = call_target(call)
+                    if name in threads_mod.COLLECTIVE_CALL_NAMES:
+                        hit = f"collective {name}()"
+                    else:
+                        for callee in graph.resolve_call(call, fn):
+                            if callee.key in bearing:
+                                hit = (f"reaches a collective via "
+                                       f"{callee.short()}")
+                                break
+                if hit is not None:
+                    yield Finding(
+                        RULE_NAME, fn.rel, call.lineno,
+                        f"chief-gated call {hit} — peers never post the "
+                        "matching collective and the chief hangs in it "
+                        "(SPMD divergence); hoist the collective out of "
+                        "the is_chief()/process_index()==0 branch")

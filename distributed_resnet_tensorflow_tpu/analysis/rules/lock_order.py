@@ -1,0 +1,41 @@
+"""lock-order-cycle: the lock acquisition-order graph stays acyclic.
+
+Built on ``analysis/locks.py``: lock identities from their construction
+sites, acquisitions from ``with <lock>:`` statements, and an edge A→B
+whenever code lexically inside ``with A:`` either nests ``with B:`` or
+calls (via the conservative resolver) into a function that transitively
+acquires B. A cycle in that graph is a deadlock waiting for the right
+thread interleaving — including the length-1 cycle of re-acquiring a
+non-reentrant ``threading.Lock`` on the same call path, which needs no
+second thread at all.
+
+One finding per elementary cycle, anchored at the acquisition site that
+introduces the first edge (so suppression — ``# shardcheck:
+ok(lock-order-cycle)`` on that line — vets exactly one cycle). Lock
+identity is per class attribute: a cycle between two INSTANCES of one
+class shows up as a self-cycle on the shared identity; if the instances
+are provably distinct and ordered, suppress with the audit comment.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..report import Finding
+from .. import locks as locks_mod
+
+RULE_NAME = "lock-order-cycle"
+DOC = __doc__
+
+
+def check(ctx) -> Iterable[Finding]:
+    edges = locks_mod.build_order_graph(ctx)
+    for cycle in locks_mod.find_cycles(edges):
+        first = cycle[0]
+        chain = " -> ".join([e.held for e in cycle] + [cycle[0].held])
+        sites = "; ".join(
+            f"{e.held} then {e.acquired} at {e.rel}:{e.lineno} ({e.via})"
+            for e in cycle)
+        yield Finding(
+            RULE_NAME, first.rel, first.lineno,
+            f"lock acquisition cycle {chain} — deadlock under the right "
+            f"interleaving. Edges: {sites}")

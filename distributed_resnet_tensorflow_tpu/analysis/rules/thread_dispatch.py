@@ -1,0 +1,71 @@
+"""cross-thread-dispatch: device-executing calls stay on dispatch threads.
+
+The PR 2 incident, statically: only ONE thread per process may launch
+multi-device XLA executions (the train-loop/consumer thread, or the serve
+dispatch thread) — a second launcher interleaves per-device enqueue
+orders and the next collective-bearing step deadlocks. The runtime
+dispatch sanitizer (``analysis/dispatch_sanitizer.py``) catches this
+live; this rule is its static complement over the thread-role registry
+(``analysis/threads.py``):
+
+  * every ``threading.Thread(target=...)`` spawn site (and executor
+    ``submit`` of a package function) must resolve to a role in
+    ``THREAD_ROLES`` — an unregistered spawn is a finding, which is what
+    keeps the thread inventory (docs/static_analysis.md) honest;
+  * from every spawn target whose role is NOT ``dispatch``, the rule
+    walks the call graph; a reachable dispatch-bearing call (executing a
+    ``jitted_*`` step, ``finalize_staged``/``StagedBatch.finalize`` —
+    the compiled unpack) is a finding at that call site, naming the
+    spawning thread.
+
+Like all of hangcheck this under-approximates: callbacks and iterator
+indirection contribute no edges (the staging worker's ``src`` iterator
+is dynamic), so a clean pass is "no path the resolver can see" — the
+runtime sanitizer remains the backstop.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..report import Finding
+from .. import threads as threads_mod
+from ..callgraph import get_callgraph
+
+RULE_NAME = "cross-thread-dispatch"
+DOC = __doc__
+
+
+def check(ctx) -> Iterable[Finding]:
+    graph = get_callgraph(ctx)
+    for spawn in threads_mod.iter_spawn_sites(ctx):
+        if spawn.target is None:
+            if spawn.kind == "thread":
+                yield Finding(
+                    RULE_NAME, spawn.rel, spawn.lineno,
+                    f"thread spawn with unresolvable target "
+                    f"({spawn.target_desc}) — give the target a static "
+                    "definition so its role can be registered in "
+                    "analysis/threads.THREAD_ROLES")
+            continue
+        role = threads_mod.role_of(spawn.target)
+        if role is None:
+            yield Finding(
+                RULE_NAME, spawn.rel, spawn.lineno,
+                f"unregistered thread spawn target "
+                f"{spawn.target.short()} — declare its role in "
+                "analysis/threads.THREAD_ROLES (the thread-role "
+                "inventory, docs/static_analysis.md)")
+            continue
+        if role == threads_mod.ROLE_DISPATCH:
+            continue
+        for key in sorted(graph.reachable([spawn.target.key])):
+            fn = graph.funcs[key]
+            for call in threads_mod.dispatch_bearing_calls(fn):
+                yield Finding(
+                    RULE_NAME, fn.rel, call.lineno,
+                    f"multi-device dispatch reachable from the "
+                    f"{role!r}-role thread spawned at "
+                    f"{spawn.rel}:{spawn.lineno} "
+                    f"(target {spawn.target.short()}) — only the "
+                    "consumer/dispatch thread may execute compiled "
+                    "programs (docs/input_pipeline.md threading model)")

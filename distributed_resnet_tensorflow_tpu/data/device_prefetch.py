@@ -206,13 +206,32 @@ def threaded_iterator(src: Iterator, depth: int = 2,
 
     thread = threading.Thread(target=worker, daemon=True, name=name)
     thread.start()
+
+    def get_checked():
+        # timed get + liveness re-check (hangcheck untimed-blocking-call,
+        # docs/static_analysis.md): a worker killed without posting its
+        # _STOP/error sentinel (interpreter teardown, a hard native
+        # crash) must become a loud RuntimeError on the consumer thread,
+        # not a permanent park on an empty queue
+        while True:
+            try:
+                return q.get(timeout=5.0)
+            except queue_mod.Empty:
+                if not thread.is_alive():
+                    try:  # a sentinel may have landed after the timeout
+                        return q.get_nowait()
+                    except queue_mod.Empty:
+                        raise RuntimeError(
+                            f"input worker thread {name!r} died without "
+                            "reporting — upstream iterator lost") from None
+
     try:
         while True:
             if wait_stage is None:
-                item = q.get()
+                item = get_checked()
             else:
                 t0 = time.perf_counter()
-                item = q.get()
+                item = get_checked()
                 input_stages.add(wait_stage, time.perf_counter() - t0,
                                  items=1)
             if item is _STOP:

@@ -305,10 +305,13 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
         except BaseException as e:
             out_q.put(_Failure(repr(e)))
 
-    threading.Thread(target=feeder, daemon=True).start()
+    worker_threads = [threading.Thread(target=feeder, daemon=True)]
     if not use_procs:
-        for i in range(n_workers):
-            threading.Thread(target=decoder, args=(i,), daemon=True).start()
+        worker_threads += [
+            threading.Thread(target=decoder, args=(i,), daemon=True)
+            for i in range(n_workers)]
+    for t in worker_threads:
+        t.start()
 
     def batches():
         images = np.empty((batch_size, image_size, image_size, 3),
@@ -332,15 +335,28 @@ def imagenet_iterator(data_dir: str, batch_size: int, mode: str,
                 expected[0] += 1
 
         def next_item():
-            if not use_procs:
-                return out_q.get()
-            # a worker killed by a signal (segfault, OOM killer) enqueues
-            # neither _Failure nor _END — poll liveness so that becomes a
-            # loud error instead of a permanent out_q.get() block
+            # a worker killed without enqueueing _Failure or _END (a
+            # signal death for processes; interpreter teardown or a hard
+            # native crash for threads) must become a loud error, not a
+            # permanent out_q.get() block — timed get + liveness poll on
+            # BOTH paths (hangcheck untimed-blocking-call,
+            # docs/static_analysis.md)
             while True:
                 try:
                     return out_q.get(timeout=5.0)
                 except queue_mod.Empty:
+                    if not use_procs:
+                        # decode THREADS: all dead with nothing queued
+                        # means items were lost, not still in flight
+                        if not any(t.is_alive() for t in worker_threads):
+                            try:
+                                return out_q.get_nowait()
+                            except queue_mod.Empty:
+                                raise RuntimeError(
+                                    "imagenet decode thread(s) died "
+                                    "without reporting — stream lost"
+                                ) from None
+                        continue
                     dead = [w for w in workers if not w.is_alive()
                             and w.exitcode not in (0, None)]
                     if dead:

@@ -115,7 +115,8 @@ class OverlapStats:
     def record(self, bucket_bytes: int, bucket_sizes: Sequence[int],
                bucket_leaves: Sequence[int], total_bytes: int,
                n_leaves: int, compress: Optional[str] = None,
-               wire_bytes: Optional[Sequence[int]] = None) -> None:
+               wire_bytes: Optional[Sequence[int]] = None,
+               declared: Optional[Sequence[Sequence[str]]] = None) -> None:
         with self._lock:
             self._plan = {
                 "buckets": len(bucket_sizes),
@@ -133,6 +134,11 @@ class OverlapStats:
                 else [int(b) for b in bucket_sizes],
                 "wire_bytes": int(sum(wire_bytes)) if wire_bytes is not None
                 else int(total_bytes),
+                # per-bucket declared collective sequences (bucket order =
+                # issue order): what analysis/collectives.py cross-checks
+                # the traced jaxpr schedule against
+                "declared_collectives": [list(b) for b in declared]
+                if declared is not None else None,
             }
 
     def reset(self) -> None:
@@ -265,6 +271,38 @@ def _param_specs(params: Any, mesh: Mesh):
     shardings = tree_param_shardings(params, mesh)
     return jax.tree_util.tree_map(lambda s: s.spec, shardings,
                                   is_leaf=lambda x: hasattr(x, "spec"))
+
+
+def declared_bucket_collectives(specs, out_specs=None) -> List[str]:
+    """The collective-issue sequence ``_exchange_bucket`` will emit for
+    one bucket, as ``"<kind>@<axis>[+<axis>…]"`` strings — the DECLARED
+    plan hangcheck's schedule extractor (analysis/collectives.py) checks
+    the traced jaxpr against: replicated leaves ride ONE tuple-psum over
+    both batch axes; each fsdp/ZeRO-sharded leaf reduce-scatters FIRST on
+    its sharded axis, then psums (or scatters) the remainder. Must mirror
+    ``_exchange_bucket`` exactly — a drift between the two IS the gate
+    finding."""
+    if out_specs is None:
+        out_specs = specs
+    ops: List[str] = []
+    z1_dims = [_axis_dim(o, "data") for o in out_specs]
+    if any(_fsdp_dim(s) is None and z1_dims[i] is None
+           for i, s in enumerate(specs)):
+        ops.append("psum@" + "+".join(BATCH_AXES))
+    for i, spec in enumerate(specs):
+        d = _fsdp_dim(spec)
+        dz = z1_dims[i]
+        if d is None and dz is None:
+            continue
+        if d is not None:
+            ops.append("psum_scatter@fsdp")
+        if dz is not None:
+            ops.append("psum_scatter@data")
+            if d is None:
+                ops.append("psum@fsdp")
+        else:
+            ops.append("psum@data")
+    return ops
 
 
 def _exchange_bucket(leaves, specs, out_specs=None, compress=None):
@@ -442,11 +480,15 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                 wire_sizes = [int(b * ratio) for b in bucket_sizes]
             else:
                 wire_sizes = bucket_sizes
+            declared = [declared_bucket_collectives(
+                [spec_leaves[i] for i in b], [z1_leaves[i] for i in b])
+                for b in buckets]
             overlap_stats.record(plan.bucket_bytes, bucket_sizes,
                                  [len(b) for b in buckets],
                                  sum(leaf_bytes), len(leaves),
                                  compress=plan.compress,
-                                 wire_bytes=wire_sizes)
+                                 wire_bytes=wire_sizes,
+                                 declared=declared)
             out_leaves: List[Any] = [None] * len(leaves)
             anchor = None
             for b, nbytes, wbytes in zip(buckets, bucket_sizes,

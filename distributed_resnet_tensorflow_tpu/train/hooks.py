@@ -360,7 +360,13 @@ class CommOverlapHook(_SnapshotExportHook):
 
     def _snapshot(self):
         from ..parallel.overlap import overlap_stats
-        return overlap_stats.snapshot()
+        snap = overlap_stats.snapshot()
+        if snap is not None:
+            # analysis-facing, unbounded (one op string per exchanged leaf
+            # per bucket) and not in EVENT_SCHEMAS["comm_overlap"]: the
+            # schedule cross-check reads it straight off overlap_stats
+            snap.pop("declared_collectives", None)
+        return snap
 
 
 class CorruptRecordsHook(_CadenceHook):

@@ -10,16 +10,41 @@
 #     shape and config bugs surface here with the offending param path,
 #     not as a step-1 _SpecError after a 20-minute queue wait.
 #
-#   scripts/analysis_gate.sh               # full gate (lint + all presets)
-#   scripts/analysis_gate.sh --lint-only   # sub-second syntax/invariant pass
+#   scripts/analysis_gate.sh                 # full gate (lint + elaborate
+#                                            #   + zero1 sweep + hangcheck)
+#   scripts/analysis_gate.sh --lint-only     # sub-second syntax/invariant pass
+#   scripts/analysis_gate.sh --no-hangcheck  # skip the hangcheck phases
+#                                            #   (mirrors --no-zero1-sweep)
 #
 # Wired as a pre-submit step in scripts/submit_tpu_slurm.sh and into the
 # pre-merge chaos gate (scripts/chaos_smoke.sh --fast). Exit 0 = clean,
 # 1 = findings (per the resilience.EXIT_CONTRACT failure code).
+#
+# Budget contract (docs/static_analysis.md): the FULL gate finishes in
+# <120 s — per-phase wall times are printed by the check CLI (lint /
+# elaborate / elab-zero1 / hangcheck-schedule lines), and this script
+# fails loudly when the total busts the budget, so creep shows up as a
+# red gate in the PR that caused it, not as a slow submit host months
+# later. Scoped runs (--lint-only, --preset, --no-*) enforce the same
+# ceiling trivially.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+GATE_BUDGET_SECS=${GATE_BUDGET_SECS:-120}
+start=$(date +%s)
+
 # all presets is `check`'s default — not hardcoded here, so pass-through
 # args like `--preset smoke` or `--lint-only` scope the gate cleanly
-exec env JAX_PLATFORMS=cpu python -m distributed_resnet_tensorflow_tpu.main \
-  check "$@"
+rc=0
+env JAX_PLATFORMS=cpu python -m distributed_resnet_tensorflow_tpu.main \
+  check "$@" || rc=$?
+
+elapsed=$(( $(date +%s) - start ))
+echo "analysis_gate: total ${elapsed}s (budget ${GATE_BUDGET_SECS}s)"
+if [[ $elapsed -gt $GATE_BUDGET_SECS ]]; then
+  echo "analysis_gate: BUDGET EXCEEDED — the gate took ${elapsed}s," \
+       "contract is <${GATE_BUDGET_SECS}s (docs/static_analysis.md)." \
+       "Find the phase that crept in the per-phase times above." >&2
+  exit 1
+fi
+exit $rc

@@ -26,9 +26,11 @@ if [[ "${1:-}" == "--fast" ]]; then
   MARK_ARGS=(-m "not slow and not heavy")
   shift
   # the fast pre-merge gate also runs shardcheck (lint + static
-  # elaboration, scripts/analysis_gate.sh): spec/config/invariant bugs
-  # should die here, in seconds, not on the cluster
-  scripts/analysis_gate.sh
+  # elaboration + hangcheck's collective-schedule/thread/lock passes,
+  # scripts/analysis_gate.sh): spec/config/invariant/hang bugs should
+  # die here, in seconds, not on the cluster. ANALYSIS_GATE_ARGS
+  # passes through (e.g. --no-hangcheck, mirroring --no-zero1-sweep)
+  scripts/analysis_gate.sh ${ANALYSIS_GATE_ARGS:-}
 fi
 
 # ${arr[@]+...} form: bash <4.4 trips set -u on expanding an empty array

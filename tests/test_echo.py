@@ -326,6 +326,7 @@ def _uint8_batches(n, b=8, s=16):
                "labels": rng.randint(0, 8, (b,)).astype(np.int32)}
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (ISSUE 13); input_smoke.sh covers the live fused-augment+sanitizer path
 @pytest.mark.heavy
 def test_fused_augment_train_step_sanitizer_green():
     """Fused unpack+augment end-to-end under the cross-thread dispatch
@@ -366,6 +367,7 @@ def test_attach_device_dataset_keeps_imagenet_augment():
     assert tr._aug_fn is None  # config-resolved fused choice restored
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (ISSUE 13); input_smoke.sh covers live echoing end-to-end
 @pytest.mark.heavy
 def test_echo_transfer_amortizes_transfers():
     """data.echo_transfer=2: a finite source of exactly 2 stacked groups

@@ -1,0 +1,444 @@
+"""Hangcheck tests (ISSUE 13): each thread/lock contract rule fires on a
+known-bad fixture at the expected file:line, the collective-schedule
+extractor emits deterministic signatures that match the declared bucket
+plan (and flags a seeded mismatch), and the `main.py check` CLI honors
+the exit-code contract (0 clean / 1 findings, findings carry file:line)."""
+import json
+import os
+
+import pytest
+
+from distributed_resnet_tensorflow_tpu.analysis.lint import (
+    run_lint, repo_root)
+from distributed_resnet_tensorflow_tpu.analysis.report import format_findings
+
+PKG = "distributed_resnet_tensorflow_tpu"
+
+
+def _by_rule(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.rule, []).append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cross-thread-dispatch
+# ---------------------------------------------------------------------------
+
+BAD_SPAWN = '''\
+import threading
+
+
+class Runner:
+    def work(self, trainer, staged):
+        out = trainer.jitted_train_step()(staged)          # line 6: dispatch
+        return out
+
+    def start(self):
+        t = threading.Thread(target=self.work)             # line 10: spawn
+        t.start()
+
+
+def mystery():
+    threading.Thread(target=getattr(object, "x")).start()  # line 15: dynamic
+'''
+
+
+def test_cross_thread_dispatch_fixture(tmp_path, monkeypatch):
+    pkg = tmp_path / PKG
+    pkg.mkdir()
+    (pkg / "bad_threads.py").write_text(BAD_SPAWN)
+    rel = os.path.join(PKG, "bad_threads.py")
+
+    # unregistered spawn target + unresolvable dynamic target both fire
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    hits = {(f.path, f.line) for f in by_rule["cross-thread-dispatch"]}
+    assert (rel, 10) in hits      # unregistered role
+    assert (rel, 15) in hits      # dynamic target
+
+    # registering the target with a NON-dispatch role moves the finding
+    # to the dispatch-bearing call site (the jitted execution)
+    from distributed_resnet_tensorflow_tpu.analysis import threads
+    monkeypatch.setitem(threads.THREAD_ROLES,
+                        "bad_threads.py::Runner.work", threads.ROLE_STAGING)
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    hits = {(f.path, f.line) for f in by_rule["cross-thread-dispatch"]}
+    assert (rel, 6) in hits
+    assert (rel, 10) not in hits
+
+    # a dispatch role makes the same call legal
+    monkeypatch.setitem(threads.THREAD_ROLES,
+                        "bad_threads.py::Runner.work",
+                        threads.ROLE_DISPATCH)
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    hits = {(f.path, f.line) for f in
+            by_rule.get("cross-thread-dispatch", ())}
+    assert (rel, 6) not in hits
+
+
+def test_real_tree_spawn_sites_all_registered():
+    """Every Thread/executor spawn in the real tree resolves to a role —
+    the inventory in analysis/threads.THREAD_ROLES is complete (the
+    docs/static_analysis.md thread-role table mirrors it)."""
+    from distributed_resnet_tensorflow_tpu.analysis import threads
+    from distributed_resnet_tensorflow_tpu.analysis.lint import build_context
+    ctx = build_context()
+    spawns = list(threads.iter_spawn_sites(ctx))
+    assert len(spawns) >= 8  # batcher/swap/prefetch/imagenet×2/beat/dog/ckpt
+    unresolved = [s for s in spawns if s.target is None]
+    assert unresolved == [], unresolved
+    unregistered = [s.target.short() for s in spawns
+                    if threads.role_of(s.target) is None]
+    assert unregistered == [], unregistered
+
+
+# ---------------------------------------------------------------------------
+# untimed-blocking-call
+# ---------------------------------------------------------------------------
+
+BAD_LOOP = '''\
+import queue
+
+
+def drain(q):
+    item = q.get()                       # line 5: untimed get on the loop
+    q.get(timeout=1.0)                   # timed: fine
+    cfg = {}.get("x")                    # dict.get with args: fine
+    return item
+
+
+class Trainer:
+    def train(self, q, worker):
+        out = drain(q)
+        worker.join()                    # line 14: untimed join
+        return out
+
+
+def helper_elsewhere(q):
+    return q.get()                       # unreachable from roots: fine
+'''
+
+
+def test_untimed_blocking_call_fixture(tmp_path):
+    pkg = tmp_path / PKG / "train"
+    pkg.mkdir(parents=True)
+    (pkg / "loop.py").write_text(BAD_LOOP)
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    rel = os.path.join(PKG, "train", "loop.py")
+    hits = {(f.path, f.line) for f in by_rule["untimed-blocking-call"]}
+    assert (rel, 5) in hits
+    assert (rel, 14) in hits
+    assert hits == {(rel, 5), (rel, 14)}, hits
+
+
+# ---------------------------------------------------------------------------
+# chief-gated-collective
+# ---------------------------------------------------------------------------
+
+BAD_CHIEF = '''\
+import jax
+from jax import lax
+
+
+def publish(x):
+    return lax.psum(x, "data")
+
+
+def report(writer, x):
+    if jax.process_index() == 0:
+        writer.write_scalars(0, {"x": 1.0})     # metrics: fine
+        publish(x)                              # line 12: gated collective
+
+
+def guard_form(x):
+    if jax.process_index() != 0:
+        return None
+    return publish(x)                           # line 18: gated by guard
+
+
+def everyone(x):
+    return publish(x)                           # ungated: fine
+'''
+
+
+def test_chief_gated_collective_fixture(tmp_path):
+    pkg = tmp_path / PKG
+    pkg.mkdir()
+    (pkg / "bad_chief.py").write_text(BAD_CHIEF)
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    rel = os.path.join(PKG, "bad_chief.py")
+    hits = {(f.path, f.line) for f in by_rule["chief-gated-collective"]}
+    assert (rel, 12) in hits
+    assert (rel, 18) in hits
+    assert hits == {(rel, 12), (rel, 18)}, hits
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+BAD_LOCKS = '''\
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+LOCK_C = threading.Lock()
+
+
+def forward():
+    with LOCK_A:
+        takes_b()                        # line 10: A-held call taking B
+
+
+def takes_b():
+    with LOCK_B:
+        pass
+
+
+def backward():
+    with LOCK_B:
+        takes_a()                        # line 20: B-held call taking A
+
+
+def takes_a():
+    with LOCK_A:
+        pass
+
+
+def leaf_only():
+    with LOCK_C:                         # no second lock: fine
+        pass
+'''
+
+
+def test_lock_order_cycle_fixture(tmp_path):
+    pkg = tmp_path / PKG
+    pkg.mkdir()
+    (pkg / "bad_locks.py").write_text(BAD_LOCKS)
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    rel = os.path.join(PKG, "bad_locks.py")
+    findings = by_rule["lock-order-cycle"]
+    assert len(findings) == 1, [str(f) for f in findings]
+    f = findings[0]
+    assert f.path == rel and f.line in (10, 20)
+    assert "LOCK_A" in f.message and "LOCK_B" in f.message
+
+
+def test_lock_order_self_cycle_and_suppression(tmp_path):
+    src = (
+        "import threading\n\n"
+        "LOCK = threading.Lock()\n\n\n"
+        "def outer():\n"
+        "    with LOCK:\n"
+        "        inner()                 # line 8: re-acquires LOCK\n\n\n"
+        "def inner():\n"
+        "    with LOCK:\n"
+        "        pass\n")
+    pkg = tmp_path / PKG
+    pkg.mkdir()
+    (pkg / "bad_relock.py").write_text(src)
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    rel = os.path.join(PKG, "bad_relock.py")
+    assert {(f.path, f.line) for f in by_rule["lock-order-cycle"]} == \
+        {(rel, 8)}
+    # the established suppression syntax vets the cycle (marker on the
+    # acquisition line, one above the edge's call line)
+    (pkg / "bad_relock.py").write_text(src.replace(
+        "    with LOCK:\n"
+        "        inner()                 # line 8: re-acquires LOCK",
+        "    with LOCK:\n"
+        "        inner()  # shardcheck: ok(lock-order-cycle)"))
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    assert "lock-order-cycle" not in by_rule
+
+
+# ---------------------------------------------------------------------------
+# hangcheck-schedule: extraction, declared-plan match, determinism,
+# artifact byte-identity
+# ---------------------------------------------------------------------------
+
+def _tiny_conv_preset():
+    """A cheap in-envelope conv preset for schedule tests (resnet8 on
+    8×8 synthetic images, batch 16 — divides 8 shards)."""
+    from distributed_resnet_tensorflow_tpu.utils.config import get_preset
+    cfg = get_preset("cifar10_resnet50")
+    cfg.model.resnet_size = 8
+    cfg.data.image_size = 8
+    cfg.train.batch_size = 16
+    cfg.data.eval_batch_size = 16
+    return cfg
+
+
+def test_extract_schedule_orders_explicit_collectives(devices):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from distributed_resnet_tensorflow_tpu.analysis.collectives import (
+        extract_schedule)
+    mesh = Mesh(np.array(devices).reshape(8,), ("data",))
+
+    def body(x):
+        a = jax.lax.psum(x, "data")
+        b = jax.lax.psum_scatter(x, "data", scatter_dimension=0,
+                                 tiled=True)
+        c = jax.lax.all_gather(b, "data", axis=0, tiled=True)
+        return a + c
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("data"),
+                   out_specs=P("data"))
+    sched = extract_schedule(
+        fn, jax.ShapeDtypeStruct((64, 4), jnp.float32))
+    kinds = [op["op"] for op in sched]
+    assert kinds == ["psum", "psum_scatter", "all_gather"]
+    assert sched[0]["axes"] == ["data"]
+    # bytes are PER-PARTICIPANT payloads: inside shard_map the traced
+    # avals are the local shards — (64/8, 4) f32 here
+    assert sched[0]["bytes"] == 8 * 4 * 4
+
+
+def test_schedule_matches_declared_plan_on_tiny_preset(devices,
+                                                       monkeypatch):
+    from distributed_resnet_tensorflow_tpu.analysis.collectives import (
+        run_collectives)
+    from distributed_resnet_tensorflow_tpu.utils import config as config_mod
+    monkeypatch.setitem(config_mod.PRESETS, "tiny_conv", _tiny_conv_preset)
+    findings, sigs = run_collectives(["tiny_conv"])
+    assert findings == [], format_findings(findings, verbose=True)
+    ov = sigs["tiny_conv@dp_fsdp/overlap"]
+    assert ov["plan"]["buckets"] >= 1
+    assert ov["plan"]["declared_collectives"]
+    ops = {op["op"] for op in ov["ops"]}
+    assert "psum" in ops
+    # the compressed composition halves the exchange wire bytes IN the
+    # traced signature (operands are bf16 at trace time)
+    comp = sigs["tiny_conv@dp_fsdp/bf16+compress"]
+    assert comp["plan"]["compress"] == "bf16"
+    assert sum(comp["plan"]["bucket_wire_bytes"]) * 2 == \
+        sum(comp["plan"]["bucket_bytes"])
+
+
+def test_schedule_plan_mismatch_is_a_finding(devices, monkeypatch):
+    """Seeded drift between the declared plan and the traced exchange —
+    the extractor must fail the gate at the variant locus."""
+    from distributed_resnet_tensorflow_tpu.analysis import collectives
+    from distributed_resnet_tensorflow_tpu.parallel import overlap
+    from distributed_resnet_tensorflow_tpu.utils import config as config_mod
+    monkeypatch.setitem(config_mod.PRESETS, "tiny_conv", _tiny_conv_preset)
+    real = overlap.declared_bucket_collectives
+
+    def drifted(specs, out_specs=None):
+        return real(specs, out_specs) + ["all_to_all@data"]
+
+    monkeypatch.setattr(overlap, "declared_bucket_collectives", drifted)
+    findings, _ = collectives.run_collectives(["tiny_conv"])
+    hits = [f for f in findings if f.rule == "hangcheck-schedule"
+            and "declared" in f.message]
+    assert hits, format_findings(findings, verbose=True)
+    assert "tiny_conv@" in hits[0].path
+
+
+def test_check_declared_plan_subsequence_semantics():
+    from distributed_resnet_tensorflow_tpu.analysis.collectives import (
+        check_declared_plan)
+    sched = [
+        {"op": "all_gather", "axes": ["fsdp"]},   # forward gather: noise
+        {"op": "psum", "axes": ["data", "fsdp"]},
+        {"op": "psum_scatter", "axes": ["fsdp"]},
+        {"op": "psum", "axes": ["data"]},
+        {"op": "psum", "axes": ["data", "fsdp"]},  # loss psum: noise
+    ]
+    ok = [["psum@data+fsdp", "psum_scatter@fsdp", "psum@data"]]
+    assert check_declared_plan(sched, ok, "x") == []
+    # a genuine order violation: psum@data precedes psum_scatter@fsdp
+    # nowhere in the trace (subsequence semantics tolerate interleaved
+    # noise, never reordering)
+    bad = [["psum@data", "psum_scatter@fsdp"]]
+    found = check_declared_plan(sched, bad, "x")
+    assert found and found[0].rule == "hangcheck-schedule"
+
+
+def test_artifact_is_byte_identical_across_writes(tmp_path, devices,
+                                                  monkeypatch):
+    from distributed_resnet_tensorflow_tpu.analysis.collectives import (
+        run_collectives, write_artifact)
+    from distributed_resnet_tensorflow_tpu.utils import config as config_mod
+    monkeypatch.setitem(config_mod.PRESETS, "tiny_conv", _tiny_conv_preset)
+    _, sigs1 = run_collectives(["tiny_conv"])
+    _, sigs2 = run_collectives(["tiny_conv"])
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    write_artifact(sigs1, p1)
+    write_artifact(sigs2, p2)
+    b1, b2 = open(p1, "rb").read(), open(p2, "rb").read()
+    assert b1 == b2
+    doc = json.loads(b1)
+    assert doc["schema_version"] == 1
+    assert any(k.endswith("/overlap") for k in doc["signatures"])
+
+
+def test_committed_artifact_matches_entry_shape():
+    """The committed analysis/collective_schedules.json parses and has
+    the documented shape (docs/static_analysis.md) — the gate rewrites
+    it on every full sweep, so drift means someone edited it by hand."""
+    from distributed_resnet_tensorflow_tpu.analysis.collectives import (
+        artifact_path)
+    doc = json.load(open(artifact_path()))
+    assert doc["schema_version"] == 1
+    sigs = doc["signatures"]
+    assert any(k.endswith("/overlap") for k in sigs)
+    for entry in sigs.values():
+        for op in entry["ops"]:
+            assert set(op) == {"op", "axes", "operands", "bytes", "count"}
+
+
+# ---------------------------------------------------------------------------
+# `main.py check` CLI exit-code contract
+# ---------------------------------------------------------------------------
+
+BAD_CLI_PY = '''\
+import sys
+
+
+def leave():
+    sys.exit(3)                                 # line 5: exit-code-contract
+'''
+
+
+def test_check_cli_exit_zero_on_clean_tree():
+    from distributed_resnet_tensorflow_tpu.main import main
+    with pytest.raises(SystemExit) as e:
+        main(["check", "--lint-only"])
+    assert e.value.code == 0
+
+
+def test_check_cli_exit_nonzero_with_findings_and_file_line(tmp_path,
+                                                            capsys):
+    from distributed_resnet_tensorflow_tpu.main import main
+    pkg = tmp_path / PKG
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(BAD_CLI_PY)
+    with pytest.raises(SystemExit) as e:
+        main(["check", "--lint-only", "--root", str(tmp_path)])
+    assert e.value.code == 1          # the EXIT_CONTRACT failure code
+    out = capsys.readouterr().out
+    assert os.path.join(PKG, "bad.py") + ":5" in out
+    assert "exit-code-contract" in out
+
+
+def test_check_cli_no_hangcheck_skips_the_rules(tmp_path):
+    """--no-hangcheck mirrors --no-zero1-sweep: the four thread/lock
+    rules are excluded from the lint pass (and the schedule phase is
+    skipped — lint-only here keeps the test in seconds)."""
+    from distributed_resnet_tensorflow_tpu.main import main
+    pkg = tmp_path / PKG
+    pkg.mkdir()
+    (pkg / "bad_chief.py").write_text(BAD_CHIEF)
+    with pytest.raises(SystemExit) as e:
+        main(["check", "--lint-only", "--root", str(tmp_path)])
+    assert e.value.code == 1          # hangcheck rule fires...
+    with pytest.raises(SystemExit) as e:
+        main(["check", "--lint-only", "--no-hangcheck",
+              "--root", str(tmp_path)])
+    assert e.value.code == 0          # ...and is opted out cleanly

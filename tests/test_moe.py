@@ -264,6 +264,7 @@ def test_moe_top2_trains_through_trainer():
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (ISSUE 13); runs in the full (unfiltered) suite with the other MoE-exactness slow tier
 @pytest.mark.heavy
 def test_gather_dispatch_matches_einsum():
     """The O(N+EC) gather dispatch == the one-hot einsum dispatch exactly

@@ -141,8 +141,14 @@ def test_fp16_step_refused_with_reason():
 @pytest.mark.parametrize("mesh_cfg,opt,zero1", [
     (MeshConfig(data=8), "momentum", "off"),
     (MeshConfig(data=4, fsdp=2), "momentum", "off"),
-    (MeshConfig(data=8), "lamb", "on"),
-    (MeshConfig(data=4, fsdp=2), "lamb", "on"),
+    # lamb_zero1 legs re-tiered out of the 870s tier-1 (ISSUE 13): the
+    # momentum legs pin the bf16-vs-f32 oracle; the LAMB×ZeRO-1
+    # composition re-runs it with the heaviest optimizer and stays in
+    # the full (unfiltered) suite
+    pytest.param(MeshConfig(data=8), "lamb", "on",
+                 marks=pytest.mark.slow),
+    pytest.param(MeshConfig(data=4, fsdp=2), "lamb", "on",
+                 marks=pytest.mark.slow),
 ], ids=["momentum-dp", "momentum-dp_fsdp", "lamb_zero1-dp",
         "lamb_zero1-dp_fsdp"])
 def test_bf16_step_allclose_vs_f32_oracle(mesh_cfg, opt, zero1):
@@ -172,8 +178,12 @@ def test_bf16_step_allclose_vs_f32_oracle(mesh_cfg, opt, zero1):
             assert leaf.dtype == jnp.float32
 
 
-@pytest.mark.parametrize("opt,zero1", [("lamb", "off"), ("momentum", "on")],
-                         ids=["lamb", "momentum_zero1"])
+@pytest.mark.parametrize("opt,zero1", [
+    # the lamb leg re-tiered out of the 870s tier-1 (ISSUE 13); the
+    # momentum_zero1 leg stays as the cheap remaining-matrix pin
+    pytest.param("lamb", "off", marks=pytest.mark.slow),
+    ("momentum", "on"),
+], ids=["lamb", "momentum_zero1"])
 def test_bf16_step_allclose_remaining_matrix_dp(opt, zero1):
     """The other half of the (optimizer × zero1) matrix on dp — lamb
     without ZeRO-1, momentum with — so every pairing is covered."""

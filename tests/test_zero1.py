@@ -86,7 +86,13 @@ def _opt_bytes_per_replica(state):
     MeshConfig(data=8),
     MeshConfig(data=4, fsdp=2),
 ], ids=["dp", "dp_fsdp"])
-@pytest.mark.parametrize("opt", ["momentum", "lamb"])
+@pytest.mark.parametrize("opt", [
+    "momentum",
+    # re-tiered out of the 870s tier-1 (ISSUE 13): the momentum leg pins
+    # the exchange numerics; the LAMB leg re-runs them with the heavier
+    # trust-ratio optimizer and stays in the full (unfiltered) suite
+    pytest.param("lamb", marks=pytest.mark.slow),
+])
 def test_zero1_matches_replicated_update(mesh_cfg, opt):
     """ZeRO-1 on vs off after a few steps: allclose at f32 tolerance
     (the reduction trees differ — reduce-scatter + sharded norms vs the
@@ -145,6 +151,7 @@ def test_zero1_overlap_matches_plain_path(mesh_cfg):
     np.testing.assert_allclose(over, base, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (ISSUE 13); the bench zero1 row measures the same live shard shapes
 def test_zero1_memory_shrinks_by_n_minus_1_over_n(devices):
     """Per-replica optimizer bytes, measured from live shard shapes: the
     shardable leaves cost exactly 1/N per replica; the total matches the
