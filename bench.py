@@ -847,6 +847,32 @@ def _overlap_ab(n_steps: int = 20):
                        "step_ms": round(dt / n_steps * 1000, 2)}
         if overlap == "on":
             rows[label]["plan"] = overlap_stats.snapshot()
+        if label == "bucketed":
+            # per-bucket runtime attribution (ISSUE 14): probe each
+            # planned bucket's collective standalone and join with the
+            # committed static schedule + the off leg's step time, so the
+            # row says WHICH bucket is the bottleneck, not one ratio
+            try:
+                from distributed_resnet_tensorflow_tpu.parallel.overlap \
+                    import probe_comm_plan
+                from distributed_resnet_tensorflow_tpu.telemetry.\
+                    comm_report import build_report, load_schedules
+                timing = probe_comm_plan(trainer.mesh)
+                if timing is not None:
+                    timing["step_secs"] = dt / n_steps
+                    report = build_report(
+                        timing, signatures=load_schedules(),
+                        step_secs_off=rows["off"]["step_ms"] / 1000.0)
+                    rows[label]["comm_report"] = {
+                        k: report.get(k)
+                        for k in ("buckets", "comm_secs_total",
+                                  "comm_step_ratio", "overlap_fraction",
+                                  "bottleneck_bucket",
+                                  "lowest_bandwidth_bucket",
+                                  "schedule_key")}
+            except Exception as e:  # the A/B numbers stand alone
+                rows[label]["comm_report"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
     rows["bucketed_vs_off"] = round(
         rows["bucketed"]["steps_per_sec"] / rows["off"]["steps_per_sec"], 3)
     return rows

@@ -40,10 +40,10 @@ from .telemetry import configure_from_config as _configure_telemetry
 from .telemetry.tracer import recorder as _flight_recorder
 from .train.hooks import (CheckpointHook, CkptAsyncHook, CkptShardHook,
                           CommCompressHook, CommOverlapHook,
-                          CorruptRecordsHook, GoodputHook, HeartbeatHook,
-                          InputEchoHook, InputStagesHook, LoggingHook,
-                          NanGuardHook, PrecisionHook, SummaryHook,
-                          Zero1Hook)
+                          CommTimingHook, CorruptRecordsHook, GoodputHook,
+                          HeartbeatHook, InputEchoHook, InputStagesHook,
+                          LoggingHook, MemoryHook, NanGuardHook,
+                          PrecisionHook, SummaryHook, Zero1Hook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
                            resolve_checkpoint_dir, stacked_layout_stamp)
@@ -146,6 +146,9 @@ def _start_watchdog(cfg: ExperimentConfig, writer, listener,
         transport, publisher, jax.process_index(), jax.process_count(),
         wd_cfg, writer=writer,
         request_stop=listener.request_stop if listener is not None else None,
+        # perf-anomaly sentinel knobs (telemetry.anomaly_*): the online
+        # step-time outlier detector rides the watchdog's detection thread
+        anomaly_cfg=cfg.telemetry,
     ).start()
     log.info("health watchdog armed: %d processes, beats -> %s "
              "(peer_timeout %.0fs, grace %.0fs)", jax.process_count(),
@@ -426,19 +429,29 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         if trainer.comm_compress_active:
             hooks.append(CommCompressHook(writer,
                                           cfg.train.summary_every_steps))
-    # per-host sharded-checkpoint accounting: EVERY process exports its
-    # own ckpt_shard rows (each host stages only its shard — the chief's
-    # stream alone would claim 1/N of the cluster's bytes). Non-chief
-    # processes get a tiny dedicated event stream (train-p<idx>) the
-    # monitor's rollup sums across hosts.
+        # measured per-bucket exchange timings (parallel/overlap.py
+        # probe) joined with the live step rate — rows appear once the
+        # probe has run; silent when the bucketed exchange is off
+        if trainer.comm_overlap_active and cfg.telemetry.comm_timing:
+            hooks.append(CommTimingHook(writer,
+                                        cfg.train.summary_every_steps))
+    # per-host accounting exported by EVERY process (the chief's stream
+    # alone would claim 1/N of the cluster): sharded-checkpoint bytes
+    # (ckpt_shard) and the device-memory trend (memory — each host
+    # samples its OWN devices). Non-chief processes get a tiny dedicated
+    # event stream (train-p<idx>) the monitor's rollup sums across hosts.
     shard_writer = None
-    if cfg.checkpoint.sharded != "off":
+    if cfg.checkpoint.sharded != "off" or cfg.telemetry.memory:
         shard_writer = writer
         if shard_writer is None:
             shard_writer = _make_writer(
                 cfg, f"train-p{jax.process_index()}")
-        hooks.append(CkptShardHook(shard_writer,
-                                   cfg.train.summary_every_steps))
+        if cfg.checkpoint.sharded != "off":
+            hooks.append(CkptShardHook(shard_writer,
+                                       cfg.train.summary_every_steps))
+        if cfg.telemetry.memory:
+            hooks.append(MemoryHook(shard_writer,
+                                    cfg.train.summary_every_steps))
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
@@ -695,16 +708,23 @@ def run_train_and_eval(cfg: ExperimentConfig):
             if trainer.comm_compress_active:
                 hooks.append(CommCompressHook(
                     writer, cfg.train.summary_every_steps))
-    # per-host sharded-ckpt accounting: every process exports, like
-    # run_train (the monitor's per-host rollup reads these)
+            if trainer.comm_overlap_active and cfg.telemetry.comm_timing:
+                hooks.append(CommTimingHook(
+                    writer, cfg.train.summary_every_steps))
+    # per-host sharded-ckpt + device-memory accounting: every process
+    # exports, like run_train (the monitor's per-host rollup reads these)
     te_shard_writer = None
-    if cfg.checkpoint.sharded != "off":
+    if cfg.checkpoint.sharded != "off" or cfg.telemetry.memory:
         te_shard_writer = writer
         if te_shard_writer is None:
             te_shard_writer = _make_writer(
                 cfg, f"train-p{jax.process_index()}")
-        hooks.append(CkptShardHook(te_shard_writer,
-                                   cfg.train.summary_every_steps))
+        if cfg.checkpoint.sharded != "off":
+            hooks.append(CkptShardHook(te_shard_writer,
+                                       cfg.train.summary_every_steps))
+        if cfg.telemetry.memory:
+            hooks.append(MemoryHook(te_shard_writer,
+                                    cfg.train.summary_every_steps))
 
     train_iter = _make_train_source(cfg, trainer)
 
@@ -795,6 +815,19 @@ def main(argv=None):
         # pure filesystem reads, no jax world, safe beside a live run
         from .telemetry.monitor import main_monitor
         sys.exit(main_monitor(argv[1:]))
+    if argv and argv[0] == "trace-merge":
+        # cluster trace correlation (telemetry/merge.py): merge the
+        # per-process trace[.procN].json dumps onto ONE timeline with
+        # per-host lanes + heartbeat-estimated clock offsets — pure
+        # filesystem reads, like monitor
+        from .telemetry.merge import main_trace_merge
+        sys.exit(main_trace_merge(argv[1:]))
+    if argv and argv[0] == "comm-report":
+        # per-collective runtime attribution (telemetry/comm_report.py):
+        # join the committed collective schedule with the measured
+        # per-bucket exchange timings into achieved bytes/sec per bucket
+        from .telemetry.comm_report import main_comm_report
+        sys.exit(main_comm_report(argv[1:]))
     serve_cmd = False
     if argv and argv[0] == "serve":
         # inference server (serve/, docs/serving.md): same flags as the

@@ -491,12 +491,13 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                                  declared=declared)
             out_leaves: List[Any] = [None] * len(leaves)
             anchor = None
-            for b, nbytes, wbytes in zip(buckets, bucket_sizes,
-                                         wire_sizes):
+            for bi, (b, nbytes, wbytes) in enumerate(
+                    zip(buckets, bucket_sizes, wire_sizes)):
                 # flight recorder: one (trace-time) span per planned
                 # bucket — the plan is visible in trace.json without
-                # instrumenting the compiled program itself
-                with span("comm.bucket", bytes=int(nbytes),
+                # instrumenting the compiled program itself. The bucket
+                # index joins the span to the plan/comm_timing rows.
+                with span("comm.bucket", bucket=bi, bytes=int(nbytes),
                           wire_bytes=int(wbytes), leaves=len(b)):
                     vals = [leaves[i] for i in b]
                     if anchor is not None:
@@ -579,9 +580,9 @@ def make_bucketed_gather(plan: OverlapPlan, mesh: Mesh,
         def body(*leaves):
             out: List[Any] = list(leaves)  # pass-throughs stay as-is
             anchor = None
-            for b, nbytes, wbytes in zip(buckets, gathered_sizes,
-                                         gathered_wire):
-                with span("zero1.gather", bytes=int(nbytes),
+            for bi, (b, nbytes, wbytes) in enumerate(
+                    zip(buckets, gathered_sizes, gathered_wire)):
+                with span("zero1.gather", bucket=bi, bytes=int(nbytes),
                           wire_bytes=int(wbytes)):
                     vals = [leaves[i] for i in b]
                     if anchor is not None:
@@ -603,3 +604,139 @@ def make_bucketed_gather(plan: OverlapPlan, mesh: Mesh,
         return jax.tree_util.tree_unflatten(treedef, sharded(*flat))
 
     return gather
+
+
+def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
+    """Measure each planned exchange bucket's collective STANDALONE on the
+    live mesh — the runtime leg of per-collective attribution
+    (docs/observability.md; the static leg is the committed
+    collective_schedules.json from analysis/collectives.py).
+
+    For every bucket of the traced plan (``overlap_stats``) this compiles
+    and times one ``lax.psum`` over the batch axes whose payload matches
+    the bucket's WIRE bytes and dtype (``comm.compress`` narrows the
+    probe exactly like the exchange). The time is the bucket's collective
+    cost fully exposed — what the overlapped step HIDES when the
+    scheduling works — so ``wire_bytes / probe_secs`` is the achieved
+    standalone bandwidth and ``Σ probe_secs / step_secs`` is the overlap
+    headroom the comm_timing row reports.
+
+    SPMD contract: every process must call this at the same program
+    point (Trainer.train does, once, at the first loop boundary after
+    the plan traces) — the probe executes real collectives, so a process
+    bailing mid-sequence while peers sit inside a psum would be a
+    divergence hang (exactly the class docs/static_analysis.md's
+    hangcheck exists to prevent). The protocol therefore front-loads all
+    fallible LOCAL work (sizing + lowering + AOT compilation — no
+    collective issued) into phase 1, then runs ONE tiny agreement psum:
+    a process whose local prep failed still participates with a 0 flag,
+    and a non-unanimous total makes EVERY process abandon together
+    before any bucket collective launches. Phase 3 (payload allocation +
+    the timed collectives — coordinated executions by nature, so they
+    cannot precede the vote) then carries the same irreducible risk as
+    any training-step collective: a mid-execution failure there means
+    the mesh is already broken and the watchdog owns recovery. Results land in
+    ``utils.metrics.comm_timing_stats``; returns the recorded snapshot,
+    or None when no plan has traced / the probe was abandoned. Never
+    raises (observability must not kill training)."""
+    import math
+    import time as _time
+
+    from jax.sharding import NamedSharding
+
+    from ..utils.metrics import comm_timing_stats
+    from .mesh import shard_map_compat
+
+    snap = overlap_stats.snapshot()
+    if snap is None:
+        return None
+    compress = snap.get("compress", "off")
+    wire_dtype = np.dtype(np.float32) if compress == "off" \
+        else np.dtype(COMPRESS_DTYPES[compress])
+    axes = [a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1] \
+        or list(BATCH_AXES)
+    replicated = NamedSharding(mesh, P())
+
+    # -- phase 1: LOCAL prep (deterministic; no collective issued) -------
+    programs = []
+    agree_c = None
+    ok = 1.0
+    try:
+        def _agree(x):
+            return lax.psum(x, tuple(mesh.axis_names))  # global, all axes
+
+        agree_c = jax.jit(shard_map_compat(
+            _agree, mesh, in_specs=P(), out_specs=P()))
+
+        def _psum(x):
+            return lax.psum(x, tuple(axes))
+
+        for bi, (nbytes, wbytes, leaves) in enumerate(zip(
+                snap["bucket_bytes"], snap["bucket_wire_bytes"],
+                snap["bucket_leaves"])):
+            elems = max(1, int(wbytes) // wire_dtype.itemsize)
+            # AOT-compile BOTH programs now — jax.jit alone is lazy and
+            # would push compilation past the vote into phase 3
+            fn = jax.jit(shard_map_compat(
+                _psum, mesh, in_specs=P(), out_specs=P())).lower(
+                    jax.ShapeDtypeStruct((elems,), wire_dtype,
+                                         sharding=replicated)).compile()
+            fill = jax.jit(lambda e=elems: jnp.zeros((e,), wire_dtype),
+                           out_shardings=replicated).lower().compile()
+            programs.append((bi, int(nbytes), int(wbytes), int(leaves),
+                             fn, fill))
+    except Exception:  # pragma: no cover - prep is best effort
+        log.exception("comm-plan probe prep failed; voting to abandon")
+        ok = 0.0
+
+    # -- phase 2: agreement (first coordinated execution) ----------------
+    if agree_c is None:  # can't even vote; peers' agreement psum will
+        return None      # surface it (irreducible — see the docstring)
+    try:
+        flag = jax.make_array_from_callback(
+            (), replicated, lambda idx: np.asarray(ok, np.float32))
+        total_ok = float(np.asarray(jax.device_get(agree_c(flag))))
+        n_devices = math.prod(mesh.shape.values())
+        if total_ok < n_devices - 0.5:  # a peer's prep failed: all bail
+            log.warning("comm-plan probe abandoned by agreement "
+                        "(%.0f/%d devices ready)", total_ok, n_devices)
+            return None
+    except Exception:  # pragma: no cover - mesh already compromised
+        log.exception("comm-plan probe agreement failed; comm_timing row "
+                      "will be absent")
+        return None
+
+    # -- phase 3: the timed collectives (all processes committed) --------
+    buckets = []
+    total = 0.0
+    try:
+        for bi, nbytes, wbytes, leaves, fn, fill in programs:
+            x = fill()
+            jax.block_until_ready(fn(x))  # compile + warm
+            best = None
+            for _ in range(max(1, reps)):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(fn(x))
+                dt = _time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            with span("comm.probe", bucket=bi, bytes=nbytes,
+                      wire_bytes=wbytes):
+                pass  # the probe span marks the measurement in the trace
+            total += best
+            buckets.append({
+                "bucket": bi,
+                "bytes": nbytes,
+                "wire_bytes": wbytes,
+                "leaves": leaves,
+                "probe_secs": round(best, 6),
+                "wire_bytes_per_sec": round(wbytes / best, 1)
+                if best > 0 else 0.0,
+            })
+    except Exception:  # pragma: no cover - the mesh is already broken
+        log.exception("comm-plan probe failed mid-measurement; "
+                      "comm_timing row will be absent")
+        return None
+    comm_timing_stats.record(buckets, total, max(1, reps), axes, compress)
+    log.info("comm probe: %d bucket(s), %.2f ms standalone exchange "
+             "(compress=%s)", len(buckets), total * 1e3, compress)
+    return comm_timing_stats.snapshot()

@@ -888,6 +888,25 @@ def finalize_staged(batch):
     return batch.finalize() if isinstance(batch, StagedBatch) else batch
 
 
+# live stagers, for the device-memory telemetry's staging-ring occupancy
+# (telemetry/memory.py): weak so a Trainer teardown releases its rings
+_LIVE_STAGERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def staging_occupancy() -> Tuple[int, int]:
+    """(ring slots, slots with an in-flight H2D transfer) summed across
+    every live CoalescedStager's layouts — the staging-ring occupancy the
+    ``{"event": "memory"}`` rows report. Lock-free reads of telemetry-
+    grade accuracy: a slot flipping mid-scan is off by one for one
+    sample."""
+    slots = inflight = 0
+    for stager in list(_LIVE_STAGERS):
+        for layout in list(stager._layouts.values()):
+            slots += len(layout.inflight)
+            inflight += sum(1 for p in layout.inflight if p is not None)
+    return slots, inflight
+
+
 class CoalescedStager:
     """Coalesced host→device staging: ONE transfer issue per batch.
 
@@ -940,6 +959,7 @@ class CoalescedStager:
         self._devices = [d for d, _ in self._shards]
         self._n_shards = batch_shard_count_total(mesh)
         self._lo_shard = min(s for _, s in self._shards)
+        _LIVE_STAGERS.add(self)  # staging-ring occupancy telemetry
 
     def _spec_of(self, batch) -> Tuple:
         return tuple(sorted(

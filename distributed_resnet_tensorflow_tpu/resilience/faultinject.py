@@ -223,17 +223,40 @@ def inject_freeze(data_iter: Iterator[Dict], at_batch: int,
         yield batch
 
 
-def inject_slow(data_iter: Iterator[Dict],
-                delay_secs: float) -> Iterator[Dict]:
-    """Delay every batch by ``delay_secs`` — the persistent-straggler
-    shape: the process keeps up with every collective, just late, which is
-    exactly what the watchdog's per-host step-rate accounting exists to
-    surface (``{"event": "straggler"}`` rows)."""
+def inject_slow(data_iter: Iterator[Dict], delay_secs: float,
+                from_batch: int = 1) -> Iterator[Dict]:
+    """Delay every batch from the ``from_batch``-th on by ``delay_secs``
+    — the persistent-straggler shape: the process keeps up with every
+    collective, just late, which is exactly what the watchdog's per-host
+    step-rate accounting (``{"event": "straggler"}`` rows) and the
+    perf-anomaly sentinel (``{"event": "perf_anomaly"}``) exist to
+    surface. The default onset (batch 1) is the from-the-start straggler;
+    a later onset (the ``S@N`` env form) gives the sentinel a healthy
+    baseline window first — the slow-REGIME-change shape a median+MAD
+    outlier detector is built for."""
     if delay_secs < 0:
         raise ValueError(f"delay_secs must be >= 0, got {delay_secs}")
+    if from_batch < 1:
+        raise ValueError(f"from_batch is 1-based, got {from_batch}")
+    count = 0
     for batch in data_iter:
-        time.sleep(delay_secs)
+        count += 1
+        if count >= from_batch:
+            time.sleep(delay_secs)
         yield batch
+
+
+def _parse_slow(value: str):
+    """``"S"`` or ``"S@N"`` → (delay_secs, from_batch). Raises ValueError
+    on junk — including ``N < 1`` (from_batch is 1-based) — so the shared
+    scoped-env path logs and disarms instead of arming a wrapper that
+    would blow up mid-training on its first batch."""
+    if "@" in value:
+        secs, _, start = value.partition("@")
+        if int(start) < 1:
+            raise ValueError(f"from_batch is 1-based, got {start}")
+        return float(secs), int(start)
+    return float(value), 1
 
 
 def _parse_scoped(value: str, env_var: str,
@@ -279,7 +302,9 @@ def maybe_wrap_from_env(data_iter: Iterator[Dict],
     source passes through so subprocess tests / chaos scripts can inject
     without patching code: ``DRT_FAULT_NAN_AT_BATCH=N`` (NaN images at
     batch N), ``DRT_FAULT_FREEZE_AT_BATCH=[pid:]N`` (wedge at batch N),
-    ``DRT_FAULT_SLOW_BATCH_SECS=[pid:]S`` (S seconds extra per batch).
+    ``DRT_FAULT_SLOW_BATCH_SECS=[pid:]S[@N]`` (S seconds extra per
+    batch, from batch N on — the late onset gives the perf-anomaly
+    sentinel a healthy baseline window first).
     The optional ``pid:`` prefix scopes a fault to one process of a
     multi-process world.
 
@@ -303,11 +328,12 @@ def maybe_wrap_from_env(data_iter: Iterator[Dict],
         log.warning("fault injection armed: freeze at batch %d (%s)",
                     at_batch, FREEZE_ENV_VAR)
         data_iter = inject_freeze(data_iter, at_batch)
-    delay = _scoped_env_value(environ, SLOW_ENV_VAR, process_id, float)
-    if delay is not None and delay > 0:
-        log.warning("fault injection armed: +%.3fs per batch (%s)",
-                    delay, SLOW_ENV_VAR)
-        data_iter = inject_slow(data_iter, delay)
+    slow = _scoped_env_value(environ, SLOW_ENV_VAR, process_id, _parse_slow)
+    if slow is not None and slow[0] > 0:
+        delay, from_batch = slow
+        log.warning("fault injection armed: +%.3fs per batch from batch "
+                    "%d (%s)", delay, from_batch, SLOW_ENV_VAR)
+        data_iter = inject_slow(data_iter, delay, from_batch=from_batch)
     value = environ.get(NAN_ENV_VAR, "")
     if not value or _nan_armed:
         return data_iter

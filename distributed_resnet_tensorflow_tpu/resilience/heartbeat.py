@@ -26,6 +26,7 @@ detection/teardown story and the metrics.jsonl schemas.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import logging
@@ -182,6 +183,12 @@ class HeartbeatPublisher:
     #: EWMA weight for the rolling per-step-time estimate
     EWMA_ALPHA = 0.3
 
+    #: rolling per-step-time SAMPLE window (the perf-anomaly sentinel's
+    #: median+MAD input, resilience/watchdog.py) — samples enter under
+    #: the same guards as the EWMA (no compile-laden first delta, no
+    #: post-interlude delta), so the window holds honest step times only
+    STEP_SAMPLE_CAP = 512
+
     def __init__(self, transport: BeatTransport, process_id: int,
                  interval_secs: float = 1.0,
                  clock=time.monotonic, wall_clock=time.time):
@@ -200,6 +207,9 @@ class HeartbeatPublisher:
         self._prev_step: Optional[int] = None
         self._step_stride = 1
         self._ewma_step_secs: Optional[float] = None
+        self._step_samples: collections.deque = collections.deque(
+            maxlen=self.STEP_SAMPLE_CAP)
+        self._step_sample_seq = 0  # total samples ever appended
         # True after any tick()/set_phase() — i.e. non-step activity (eval
         # round, save, poll) happened since the last step boundary, so the
         # NEXT step delta spans that pause and must not enter the EWMA
@@ -237,6 +247,8 @@ class HeartbeatPublisher:
                         self._ewma_step_secs = dt if self._ewma_step_secs is None \
                             else (1 - self.EWMA_ALPHA) * self._ewma_step_secs \
                             + self.EWMA_ALPHA * dt
+                        self._step_samples.append(dt)
+                        self._step_sample_seq += 1
                 self._interlude = False
                 self._prev_update_t = now
                 self._prev_step = step
@@ -268,6 +280,15 @@ class HeartbeatPublisher:
                     "last_progress_t": self._last_progress_t,
                     "ewma_step_secs": self._ewma_step_secs,
                     "step_stride": self._step_stride}
+
+    def step_times(self) -> dict:
+        """The rolling per-step-time sample window for the perf-anomaly
+        sentinel: ``{"seq": total samples ever, "samples": [...]}``. The
+        seq counter lets the detector skip ticks with no NEW sample (a
+        paused loop must not re-judge the same window forever)."""
+        with self._lock:
+            return {"seq": self._step_sample_seq,
+                    "samples": list(self._step_samples)}
 
     # -- publisher thread ----------------------------------------------------
     def _beat(self) -> Beat:

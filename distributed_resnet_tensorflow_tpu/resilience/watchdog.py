@@ -89,13 +89,18 @@ class Watchdog:
                  writer=None,
                  request_stop: Optional[Callable[[str], None]] = None,
                  clock=time.monotonic, wall_clock=time.time,
-                 exit_fn=os._exit):
+                 exit_fn=os._exit, anomaly_cfg=None):
         self.transport = transport
         self.publisher = publisher
         self.process_id = process_id
         self.num_processes = num_processes
         self.cfg = cfg
         self.writer = writer
+        # perf-anomaly sentinel (telemetry.anomaly_* knobs, a
+        # TelemetryConfig or None = disabled): the online step-time
+        # outlier detector riding this detection thread — see
+        # _check_perf_anomaly
+        self.anomaly_cfg = anomaly_cfg
         self.request_stop = request_stop
         self._clock = clock
         self._wall = wall_clock
@@ -118,6 +123,12 @@ class Watchdog:
         self._peer_poll_secs = max(cfg.interval_secs,
                                    cfg.peer_timeout_secs / 4.0)
         self._last_peer_poll = float("-inf")
+        # perf-anomaly episode state: one firing per slow regime (+ a
+        # cooldown), re-armed by the first healthy sample — a
+        # persistently slow host must not dump a trace per tick
+        self._anomaly_seen_seq = 0
+        self._anomaly_active = False
+        self._anomaly_last_fire = float("-inf")
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Watchdog":
@@ -170,6 +181,11 @@ class Watchdog:
                 self._escalate(*verdict, now=now)
         elif self._fired is not None:
             self._maybe_exit(now, peers)
+        if self._fired is None and not self._disarmed:
+            # perf-anomaly sentinel: a SLOW step is not a hang — no
+            # teardown, no stop request — but it deserves the same
+            # flight-recorder evidence a hang gets, while it is happening
+            self._check_perf_anomaly(now)
         # chief-only: _export is a no-op without a writer, and the extra
         # beat-directory scan it would force on every non-chief process
         # is exactly the shared-FS tax detection must not impose
@@ -243,6 +259,77 @@ class Watchdog:
                     + (f", rolling step time {est:.3f}s" if est else "")
                     + ")")
         return None
+
+    # -- perf-anomaly sentinel ----------------------------------------------
+    @staticmethod
+    def _median(ordered) -> float:
+        mid = len(ordered) // 2
+        return ordered[mid] if len(ordered) % 2 else \
+            (ordered[mid - 1] + ordered[mid]) / 2.0
+
+    def _check_perf_anomaly(self, now: float) -> None:
+        """Online step-time outlier detection (telemetry.anomaly_*): the
+        WORST per-step-time sample since the last judgment against the
+        preceding window's median + max(anomaly_mad_k × MAD,
+        (anomaly_min_ratio − 1) × median). Judging every fresh sample —
+        not just the newest — matters because several steps land per
+        watchdog tick on a fast run, and a transient 2×-slow step
+        followed by fast ones must not slip through the tick phase. MAD
+        adapts the threshold to the run's own jitter; the ratio floor
+        keeps an ultra-steady run (MAD ≈ 0) from flagging micro-hiccups.
+        A hit writes a ``perf_anomaly`` metrics row and dumps the flight
+        recorder — evidence while the slowness is LIVE — but never tears
+        the run down: slow-but-alive is an observability event, not a
+        failure (docs/observability.md)."""
+        acfg = self.anomaly_cfg
+        if acfg is None or not getattr(acfg, "anomaly_detection", False):
+            return
+        st = self.publisher.step_times()
+        n_new = st["seq"] - self._anomaly_seen_seq
+        if n_new <= 0:
+            return  # no new sample since the last judgment
+        self._anomaly_seen_seq = st["seq"]
+        samples = st["samples"]
+        min_base = max(4, acfg.anomaly_min_samples)
+        # the judged batch never eats into the baseline's minimum — at
+        # bootstrap (everything is "fresh") only the tail is judged
+        n_new = min(n_new, max(1, len(samples) - min_base))
+        base = samples[:-n_new][-max(4, acfg.anomaly_window):]
+        if len(base) < min_base:
+            return
+        newest = max(samples[-n_new:])
+        window = sorted(base)
+        median = self._median(window)
+        mad = self._median(sorted(abs(s - median) for s in window))
+        threshold = median + max(acfg.anomaly_mad_k * mad,
+                                 (acfg.anomaly_min_ratio - 1.0) * median)
+        if newest <= threshold:
+            self._anomaly_active = False  # episode over; re-arm
+            return
+        if self._anomaly_active or \
+                now - self._anomaly_last_fire < acfg.anomaly_cooldown_secs:
+            return
+        self._anomaly_active = True
+        self._anomaly_last_fire = now
+        snap = self.publisher.snapshot()
+        detail = (f"step {snap['step']}: {newest:.3f}s/step vs rolling "
+                  f"median {median:.3f}s (MAD {mad:.4f}s, threshold "
+                  f"{threshold:.3f}s, window {len(window)}) — slow but "
+                  "alive, no teardown")
+        log.warning("watchdog: perf anomaly — %s", detail)
+        self._write_event("perf_anomaly", {
+            "step": snap["step"], "detail": detail,
+            "step_secs": round(newest, 6),
+            "median_secs": round(median, 6),
+            "mad_secs": round(mad, 6),
+            "threshold_secs": round(threshold, 6),
+            "window": len(window)})
+        try:
+            from ..telemetry.tracer import recorder
+            recorder.dump_on_anomaly("perf_anomaly", detail)
+        except Exception:  # pragma: no cover - observability best effort
+            log.exception("watchdog: perf-anomaly flight-recorder dump "
+                          "failed")
 
     # -- escalation ----------------------------------------------------------
     def _escalate(self, kind: str, code: int, detail: str,
@@ -407,12 +494,9 @@ class Watchdog:
         rates = self._rates(wall_now)
         if not rates:
             return
-        ordered = sorted(rates.values())
-        mid = len(ordered) // 2
         # true median: the upper-middle element alone would be the MAX in
         # a 2-host world, flagging against the fastest host instead
-        median = ordered[mid] if len(ordered) % 2 else \
-            (ordered[mid - 1] + ordered[mid]) / 2.0
+        median = self._median(sorted(rates.values()))
         max_step = max(b.step for b in peers.values())
         flagged = sorted(
             pid for pid, r in rates.items()

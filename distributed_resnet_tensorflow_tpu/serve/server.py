@@ -38,6 +38,12 @@ from .swap import CheckpointSwapper, PendingSwap
 
 log = logging.getLogger(__name__)
 
+#: dispatch batches between ``{"event": "memory"}`` samples
+#: (telemetry.memory gates the rows entirely): coarse enough that the
+#: live_arrays scan never shows in serve tail latency, fine enough that a
+#: leak over a day-long run has hundreds of trend points
+_MEMORY_EVERY_BATCHES = 50
+
 
 def serve_image_spec(cfg: ExperimentConfig) -> Tuple[Tuple[int, ...], type]:
     """(per-example shape, dtype) of a serving request — must match what
@@ -138,6 +144,7 @@ class InferenceServer:
         self.swaps = 0
         self._t_start = time.monotonic()
         self._closed = False
+        self._batches_since_mem = 0  # serve-side memory-row cadence
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, start_threads: bool = True) -> "InferenceServer":
@@ -259,6 +266,20 @@ class InferenceServer:
                 "variant": variant,
                 "queue_ms": round((t0 - group[0].t_submit) * 1000.0, 3),
                 "run_ms": round((t1 - t0) * 1000.0, 3)})
+            self._batches_since_mem += 1
+            if self._batches_since_mem >= _MEMORY_EVERY_BATCHES:
+                self._write_memory_row()
+
+    def _write_memory_row(self) -> None:
+        """One ``{"event": "memory"}`` sample (telemetry/memory.py) from
+        the serving process — HBM/RSS trend lines for a server that runs
+        for days, at the batch cadence so an idle server stays silent."""
+        if self.writer is None or not self.cfg.telemetry.memory:
+            return
+        self._batches_since_mem = 0
+        from ..telemetry.memory import sample_memory
+        self.writer.write_event("memory", {"step": self.serving_step,
+                                           **sample_memory()})
 
     # -- hot swap ----------------------------------------------------------
     def _apply_pending_swap(self) -> None:
@@ -361,6 +382,7 @@ class InferenceServer:
                 "step": self.serving_step,
                 "requests": self.completed, "dropped": self.dropped,
                 "buckets": self.latency.summary_ms()})
+            self._write_memory_row()  # the run's closing watermark
 
     def report(self) -> dict:
         """Snapshot report (pure read — the serve_request metrics row is

@@ -1,0 +1,295 @@
+"""Per-collective runtime attribution: ``main.py comm-report``.
+
+PR 13's hangcheck committed the STATIC collective schedule
+(``analysis/collective_schedules.json``: ordered kind/axes/bytes per
+traced step variant) and the overlap plan rows record WHAT should move
+per bucket — but neither says what each bucket actually COSTS. This
+reducer joins three sources into one per-bucket table:
+
+  * the static schedule (kind + axes per collective, committed artifact),
+  * the plan (``{"event": "comm_overlap"}``: per-bucket grad/wire bytes
+    and leaf counts, issue order),
+  * the measurement (``{"event": "comm_timing"}``: each bucket's
+    collective timed STANDALONE on the live mesh by
+    ``parallel/overlap.probe_comm_plan``, plus the measured live step
+    time)
+
+into achieved bytes/sec per bucket, each bucket's share of the total
+exchange cost, and the overlap headroom ``comm_step_ratio`` — the share
+of every step the exchange would cost if NOTHING were hidden. That makes
+"the bucketed exchange is slow" answerable as "bucket 3 (the 14.7 MB
+conv block) runs at 2.1 GB/s while its peers do 9" instead of one
+aggregate ratio.
+
+Semantics worth being precise about (docs/observability.md):
+``probe_secs`` is the bucket's collective fully EXPOSED — the overlapped
+step hides some or all of it behind backprop, so ``comm_step_ratio`` is
+an upper bound on what communication can be costing, not a measurement
+of what it does cost. The achieved OVERLAP FRACTION needs a no-exchange
+step time to difference against; pass one with ``--step-secs-off``
+(e.g. the ``off`` leg of ``bench.py``'s overlap row) and the report
+computes ``1 − (step_on − step_off) / comm_secs_total``, clamped to
+[0, 1].
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: schedule ops that can carry a gradient-exchange bucket's payload
+_EXCHANGE_OPS = ("psum", "psum_scatter")
+
+
+def default_schedule_path() -> str:
+    from .. import analysis
+    return os.path.join(os.path.dirname(analysis.__file__),
+                        "collective_schedules.json")
+
+
+def load_schedules(path: Optional[str] = None) -> Dict[str, dict]:
+    """The committed schedule artifact's ``signatures`` map; empty when
+    the file is absent/unreadable (the report degrades to measured-only
+    — a run on an uncommitted preset must still be reportable)."""
+    path = path or default_schedule_path()
+    try:
+        with open(path) as f:
+            return json.load(f).get("signatures", {})
+    except (OSError, ValueError) as e:
+        log.warning("comm-report: no readable schedule at %s (%s)", path, e)
+        return {}
+
+
+def _expanded_ops(signature: dict) -> List[dict]:
+    """The signature's op list with RLE counts expanded — one entry per
+    collective, schedule order."""
+    out: List[dict] = []
+    for op in signature.get("ops", []):
+        for _ in range(int(op.get("count", 1))):
+            out.append({k: v for k, v in op.items() if k != "count"})
+    return out
+
+
+def _match_buckets(buckets: List[dict],
+                   signature: Optional[dict]) -> Tuple[int, List[dict]]:
+    """In-order subsequence match of the measured buckets' wire bytes
+    against the schedule's exchange-capable ops (the same matching
+    discipline analysis/collectives.py uses for the declared plan).
+    Returns (matched count, buckets annotated with static kind/axes)."""
+    annotated = [dict(b) for b in buckets]
+    if not signature:
+        return 0, annotated
+    ops = _expanded_ops(signature)
+    cursor = 0
+    matched = 0
+    for b in annotated:
+        hit = None
+        for i in range(cursor, len(ops)):
+            op = ops[i]
+            if op.get("op") in _EXCHANGE_OPS and \
+                    int(op.get("bytes", -1)) == int(b["wire_bytes"]):
+                hit = i
+                break
+        if hit is None:
+            b["static"] = None
+            continue
+        cursor = hit + 1
+        matched += 1
+        b["static"] = {"kind": ops[hit].get("op"),
+                       "axes": ops[hit].get("axes"),
+                       "operands": ops[hit].get("operands")}
+    return matched, annotated
+
+
+def select_schedule_key(signatures: Dict[str, dict],
+                        buckets: List[dict],
+                        key: Optional[str] = None
+                        ) -> Tuple[Optional[str], List[str]]:
+    """Resolve which schedule signature to join against. An explicit
+    ``key`` wins (missing = error); otherwise the overlap-variant keys
+    whose op stream fully matches the measured buckets are candidates —
+    a unique one is used, several report the ambiguity."""
+    if key is not None:
+        if key not in signatures:
+            raise KeyError(
+                f"schedule key {key!r} not in the committed artifact; "
+                f"available: {sorted(signatures)}")
+        return key, [key]
+    candidates = []
+    for k in sorted(signatures):
+        # exchange-bearing variants only: the bucketed exchange traces as
+        # .../overlap, .../overlap+zero1 or (halved wire bytes under
+        # comm.compress) .../bf16+compress — train/serve variants carry no
+        # per-bucket exchange to join against
+        variant = k.rsplit("/", 1)[-1]
+        if "overlap" not in variant and "compress" not in variant:
+            continue
+        matched, _ = _match_buckets(buckets, signatures[k])
+        if buckets and matched == len(buckets):
+            candidates.append(k)
+    return (candidates[0] if len(candidates) == 1 else None), candidates
+
+
+def find_rows(root: str) -> Tuple[Optional[dict], Optional[dict]]:
+    """The newest ``comm_timing`` and ``comm_overlap`` rows under a
+    log_root (any stream — the chief writes both)."""
+    from ..utils.metrics import iter_metric_streams
+    timing = overlap = None
+    for rows in iter_metric_streams(root):
+        for row in rows:
+            if row.get("event") == "comm_timing":
+                if timing is None or row.get("time", 0) > \
+                        timing.get("time", 0):
+                    timing = row
+            elif row.get("event") == "comm_overlap":
+                if overlap is None or row.get("time", 0) > \
+                        overlap.get("time", 0):
+                    overlap = row
+    return timing, overlap
+
+
+def build_report(timing: dict, overlap: Optional[dict] = None,
+                 signatures: Optional[Dict[str, dict]] = None,
+                 key: Optional[str] = None,
+                 step_secs_off: Optional[float] = None,
+                 schedule_path: Optional[str] = None) -> dict:
+    """The joined per-bucket attribution. ``timing`` is a comm_timing
+    row (or comm_timing_stats snapshot); everything else is optional —
+    the report degrades gracefully to measured-only."""
+    signatures = signatures or {}
+    buckets = [dict(b) for b in timing.get("buckets", [])]
+    candidates: List[str] = []
+    resolved = None
+    if signatures:
+        resolved, candidates = select_schedule_key(signatures, buckets, key)
+    matched, buckets = _match_buckets(
+        buckets, signatures.get(resolved) if resolved else None)
+    comm_total = float(timing.get("comm_secs_total") or 0.0)
+    for b in buckets:
+        b["pct_of_comm"] = round(100.0 * b["probe_secs"] / comm_total, 2) \
+            if comm_total > 0 else 0.0
+    report: dict = {
+        "buckets": buckets,
+        "comm_secs_total": comm_total,
+        "compress": timing.get("compress", "off"),
+        "axes": timing.get("axes"),
+        "reps": timing.get("reps"),
+        "schedule_key": resolved,
+        "schedule_candidates": candidates,
+        "schedule_matched": matched,
+        "schedule_path": schedule_path or
+        (default_schedule_path() if signatures else None),
+    }
+    if buckets:
+        slowest = max(buckets, key=lambda b: b["probe_secs"])
+        narrowest = min(buckets, key=lambda b: b["wire_bytes_per_sec"])
+        report["bottleneck_bucket"] = slowest["bucket"]
+        report["lowest_bandwidth_bucket"] = narrowest["bucket"]
+    step_secs = timing.get("step_secs")
+    if step_secs:
+        report["step_secs"] = float(step_secs)
+        report["comm_step_ratio"] = round(comm_total / float(step_secs), 4)
+    if overlap is not None:
+        report["plan"] = {
+            "buckets": overlap.get("buckets"),
+            "bucket_cap_bytes": overlap.get("bucket_cap_bytes"),
+            "grad_bytes": overlap.get("grad_bytes"),
+            "wire_bytes": overlap.get("wire_bytes"),
+            "leaves": overlap.get("leaves"),
+        }
+    if step_secs_off is not None and step_secs and comm_total > 0:
+        exposed = max(0.0, float(step_secs) - float(step_secs_off))
+        report["step_secs_off"] = float(step_secs_off)
+        report["overlap_fraction"] = round(
+            min(1.0, max(0.0, 1.0 - exposed / comm_total)), 4)
+    return report
+
+
+def render(report: dict) -> str:
+    lines = ["== comm-report :: per-bucket runtime attribution =="]
+    if report.get("schedule_key"):
+        lines.append(f"  schedule: {report['schedule_key']} "
+                     f"({report['schedule_matched']}/"
+                     f"{len(report['buckets'])} buckets matched)")
+    elif report.get("schedule_candidates"):
+        lines.append("  schedule: ambiguous — candidates "
+                     f"{report['schedule_candidates']} (pass --key)")
+    else:
+        lines.append("  schedule: no matching signature (measured-only "
+                     "report)")
+    hdr = (f"  {'bkt':>3} {'leaves':>6} {'bytes':>12} {'wire':>12} "
+           f"{'secs':>9} {'GB/s':>7} {'%comm':>6}  static")
+    lines.append(hdr)
+    for b in report["buckets"]:
+        st = b.get("static")
+        st_txt = f"{st['kind']}@{','.join(st['axes'])}" if st else "-"
+        lines.append(
+            f"  {b['bucket']:>3} {b['leaves']:>6} {b['bytes']:>12} "
+            f"{b['wire_bytes']:>12} {b['probe_secs']:>9.6f} "
+            f"{b['wire_bytes_per_sec'] / 1e9:>7.2f} "
+            f"{b['pct_of_comm']:>6.1f}  {st_txt}")
+    lines.append(f"  total exchange (exposed): "
+                 f"{report['comm_secs_total'] * 1e3:.2f} ms "
+                 f"(compress={report.get('compress')}, "
+                 f"axes={report.get('axes')})")
+    if "step_secs" in report:
+        lines.append(
+            f"  measured step: {report['step_secs'] * 1e3:.2f} ms -> "
+            f"comm/step ratio {report['comm_step_ratio']:.3f} "
+            "(upper bound: the exchange fully exposed)")
+    if "bottleneck_bucket" in report:
+        lines.append(f"  bottleneck: bucket {report['bottleneck_bucket']} "
+                     "(largest standalone cost); lowest bandwidth: "
+                     f"bucket {report['lowest_bandwidth_bucket']}")
+    if "overlap_fraction" in report:
+        lines.append(
+            f"  overlap fraction vs step_secs_off="
+            f"{report['step_secs_off'] * 1e3:.2f} ms: "
+            f"{report['overlap_fraction']:.3f}")
+    return "\n".join(lines)
+
+
+def main_comm_report(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="main.py comm-report",
+        description="join the committed collective schedule with the "
+                    "measured per-bucket exchange timings "
+                    "(docs/observability.md)")
+    ap.add_argument("--root", default="/tmp/drt_tpu",
+                    help="the run's log_root (comm_timing/comm_overlap "
+                         "rows)")
+    ap.add_argument("--schedules", default="",
+                    help="collective_schedules.json path (default: the "
+                         "committed analysis artifact)")
+    ap.add_argument("--key", default=None,
+                    help="schedule signature key, e.g. "
+                         "'cifar10_resnet50@dp_fsdp/overlap' (default: "
+                         "unique fully-matching overlap variant)")
+    ap.add_argument("--step-secs-off", type=float, default=None,
+                    help="a no-/unbucketed-exchange step time to "
+                         "difference against (bench overlap row 'off' "
+                         "leg) -> achieved overlap fraction")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ns = ap.parse_args(argv)
+    timing, overlap = find_rows(ns.root)
+    if timing is None:
+        print(f"comm-report: no comm_timing row under {ns.root} — the "
+              "probe runs when comm.overlap is active and "
+              "telemetry.comm_timing is on")
+        return 1
+    schedule_path = ns.schedules or default_schedule_path()
+    signatures = load_schedules(schedule_path)
+    try:
+        report = build_report(timing, overlap, signatures, key=ns.key,
+                              step_secs_off=ns.step_secs_off,
+                              schedule_path=schedule_path)
+    except KeyError as e:
+        print(f"comm-report: {e.args[0]}")
+        return 1
+    print(json.dumps(report) if ns.json else render(report))
+    return 0

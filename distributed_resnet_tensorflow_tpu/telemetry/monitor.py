@@ -79,14 +79,30 @@ def _last(rows: List[dict], event: Optional[str]) -> Optional[dict]:
     return None
 
 
-def _steps_per_sec(rows: List[dict]) -> Optional[float]:
-    """Rate from the two newest scalar rows (cadence-spaced, so this is a
-    window estimate, not an instantaneous one)."""
+#: scalar rows the steps/s window spans (at the log cadence this is
+#: minutes of run — wide enough that one hiccup row amortizes away)
+_RATE_WINDOW_ROWS = 12
+
+
+def _steps_per_sec(rows: List[dict],
+                   window: int = _RATE_WINDOW_ROWS) -> Optional[float]:
+    """WINDOWED rate over the newest ``window`` scalar rows: endpoints
+    only, so one hiccup row (an eval pause, a checkpoint, a torn write)
+    moves the estimate by its share of the window instead of swinging
+    the whole dashboard the way the old newest-pair rate did."""
     scalars = [r for r in rows if "event" not in r and "step" in r
                and "time" in r]
     if len(scalars) < 2:
         return None
-    a, b = scalars[-2], scalars[-1]
+    tail = scalars[-max(2, window):]
+    # a restart resets the step counter mid-tail: rate only over the
+    # monotone suffix
+    suffix = [tail[-1]]
+    for r in reversed(tail[:-1]):
+        if r["step"] >= suffix[0]["step"] or r["time"] >= suffix[0]["time"]:
+            break
+        suffix.insert(0, r)
+    a, b = suffix[0], suffix[-1]
     dt = b["time"] - a["time"]
     ds = b["step"] - a["step"]
     if dt <= 0 or ds <= 0:
@@ -156,6 +172,36 @@ def summarize_stream(stream_dir: str, now: Optional[float] = None) -> dict:
     cr = _last(rows, "corrupt_record")
     if cr is not None:
         out["corrupt_records"] = cr.get("count")
+    mem = _last(rows, "memory")
+    if mem is not None:
+        out["memory"] = _memory_summary(mem)
+    return out
+
+
+def _memory_summary(row: dict) -> dict:
+    """One memory row folded to the rollup's per-host shape: the worst
+    device's watermark (allocator ``peak_bytes_in_use`` where the backend
+    reports it — authoritative — else the sampled live-array peak) plus
+    its limit when known, host RSS, and the pipeline-pool occupancy."""
+    peak = limit = None
+    for cell in (row.get("devices") or {}).values():
+        p = cell.get("peak_bytes_in_use", cell.get("live_peak_bytes"))
+        if p is not None:
+            peak = max(peak or 0, int(p))
+        if cell.get("bytes_limit"):
+            limit = max(limit or 0, int(cell["bytes_limit"]))
+    out = {"process": row.get("process")}
+    for key in ("live_bytes_total", "live_peak_bytes_total",
+                "host_rss_bytes", "host_peak_rss_bytes",
+                "echo_cache_bytes", "staging_ring_inflight"):
+        if row.get(key) is not None:
+            out[key] = row[key]
+    if peak is not None:
+        out["device_peak_bytes"] = peak
+    if limit:
+        out["device_bytes_limit"] = limit
+        if peak is not None:
+            out["device_peak_frac"] = round(peak / limit, 4)
     return out
 
 
@@ -199,16 +245,20 @@ def _checkpoint_step(root: str) -> Optional[int]:
     return newest
 
 
-def aggregate(root: str, now: Optional[float] = None) -> dict:
+#: per-host device-memory watermark share of the limit that flags in the
+#: dashboard (where the backend reports bytes_limit); --hbm-warn-frac
+_HBM_WARN_FRAC = 0.9
+
+
+def aggregate(root: str, now: Optional[float] = None,
+              hbm_warn_frac: float = _HBM_WARN_FRAC) -> dict:
     """The whole-run rollup: every metrics stream under ``root``, the
     heartbeat fleet, the newest committed checkpoint."""
     now = time.time() if now is None else now
     root = os.path.abspath(root)
+    from ..utils.metrics import metric_stream_dirs
     streams: Dict[str, dict] = {}
-    for path in sorted(glob.glob(os.path.join(root, "**", "metrics.jsonl"),
-                                 recursive=True)
-                       + glob.glob(os.path.join(root, "metrics.jsonl"))):
-        d = os.path.dirname(path)
+    for d in metric_stream_dirs(root):
         rel = os.path.relpath(d, root)
         if rel in streams:
             continue
@@ -247,6 +297,35 @@ def aggregate(root: str, now: Optional[float] = None) -> dict:
             sorted(by_host.items())}
         out["ckpt_shard_bytes_total"] = sum(
             row.get("shard_bytes") or 0 for row in by_host.values())
+    # per-host device-memory watermark: each process samples its OWN
+    # devices (chief in its train stream, peers in train-p<idx>), so the
+    # per-pid max over streams IS the cluster's HBM picture — the trend
+    # an OOM used to be the first sign of. A colocated serving replica
+    # is a DIFFERENT process with the same jax.process_index(); it gets
+    # its own "<pid>/serve" entry rather than shadowing (or being
+    # shadowed by) the trainer's watermark
+    mem_by_host: Dict[str, dict] = {}
+    for name, s in streams.items():
+        m = s.get("memory")
+        if m is None:
+            continue
+        pid = str(m.get("process", "?"))
+        if os.path.basename(name).startswith("serve"):
+            pid = f"{pid}/serve"
+        prev = mem_by_host.get(pid)
+        if prev is None or (m.get("device_peak_bytes") or 0) > \
+                (prev.get("device_peak_bytes") or 0):
+            mem_by_host[pid] = m
+    if mem_by_host:
+        out["memory_by_host"] = {
+            pid: m for pid, m in sorted(mem_by_host.items())}
+        warn = sorted(
+            pid for pid, m in mem_by_host.items()
+            if m.get("device_peak_frac") is not None
+            and m["device_peak_frac"] >= hbm_warn_frac)
+        if warn:
+            out["hbm_warn_frac"] = hbm_warn_frac
+            out["hbm_warn_hosts"] = warn
     # headline: the fastest train-shaped stream is the chief's
     rates = {name: s["steps_per_sec"] for name, s in streams.items()
              if "steps_per_sec" in s}
@@ -285,6 +364,21 @@ def render(agg: dict) -> str:
             f"{len(per_host)} host(s) " + " ".join(
                 f"p{pid}:{(b or 0) / 1e6:.1f}MB"
                 for pid, b in per_host.items()))
+    if "memory_by_host" in agg:
+        bits = []
+        for pid, m in agg["memory_by_host"].items():
+            peak = m.get("device_peak_bytes",
+                         m.get("live_peak_bytes_total"))
+            cell = f"p{pid}:{(peak or 0) / 1e9:.2f}GB"
+            if m.get("device_peak_frac") is not None:
+                cell += f"({m['device_peak_frac'] * 100:.0f}%)"
+            bits.append(cell)
+        lines.append("  hbm watermark (per-host device peak): "
+                     + " ".join(bits))
+        if agg.get("hbm_warn_hosts"):
+            lines.append(
+                f"  !! hbm above {agg['hbm_warn_frac'] * 100:.0f}% of "
+                f"limit on host(s): {agg['hbm_warn_hosts']}")
     if "hosts" in agg:
         lines.append(f"  hosts ({len(agg['hosts'])}; "
                      f"skew {agg.get('host_step_skew', 0)} steps):")
@@ -328,10 +422,13 @@ def main_monitor(argv=None) -> int:
                     help="emit the aggregate as JSON instead of text")
     ap.add_argument("--interval", type=float, default=5.0,
                     help="refresh cadence in seconds (live mode)")
+    ap.add_argument("--hbm-warn-frac", type=float, default=_HBM_WARN_FRAC,
+                    help="flag hosts whose device watermark exceeds this "
+                         "share of the reported bytes_limit")
     ns = ap.parse_args(argv)
     try:
         while True:
-            agg = aggregate(ns.root)
+            agg = aggregate(ns.root, hbm_warn_frac=ns.hbm_warn_frac)
             print(json.dumps(agg) if ns.json else render(agg), flush=True)
             if ns.once:
                 return 0

@@ -49,7 +49,12 @@ log = logging.getLogger(__name__)
 
 #: bump when the trace.json event shape changes (consumers key on it via
 #: the ``trace_dump`` metrics row and the file's otherData block)
-SPAN_SCHEMA_VERSION = 5  # 5: + serve.variant_build; comm.bucket /
+SPAN_SCHEMA_VERSION = 6  # 6: + comm.probe; comm.bucket / zero1.gather
+#                              gain a bucket-index arg so the merged
+#                              timeline / comm report can join spans to
+#                              the plan (performance observability,
+#                              round 14)
+#                          5: + serve.variant_build; comm.bucket /
 #                              zero1.gather gain a wire_bytes arg
 #                              (low-precision hot paths, round 12)
 #                          4: + checkpoint.shard/checkpoint.finalize/
@@ -110,6 +115,11 @@ SPAN_CATALOG = {
                    "plan, not a per-step event)",
     "zero1.gather": "one planned ZeRO-1 param-update all-gather bucket "
                     "(trace-time, like comm.bucket — the gather plan)",
+    "comm.probe": "one planned exchange bucket's collective timed "
+                  "STANDALONE on the live mesh (parallel/overlap."
+                  "probe_comm_plan; bucket/bytes/wire_bytes args — the "
+                  "runtime leg the comm_timing row and main.py "
+                  "comm-report attribute bandwidth from)",
     # serving (serve/server.py, serve/swap.py)
     "serve.batch": "one bucket dispatch: stage + AOT predict + resolve",
     "serve.swap_restore": "off-path host restore of a newer checkpoint",

@@ -79,13 +79,23 @@ class _SnapshotExportHook(_CadenceHook):
     def _snapshot(self) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
 
+    def _gate(self, snap: Dict[str, Any]) -> Dict[str, Any]:
+        """The comparison key deciding re-export (default: the whole row).
+        Subclasses whose rows carry a live measurement override this to
+        quantize it — re-export when the measurement MOVES, without the
+        noise of re-exporting its every wiggle (CommTimingHook)."""
+        return snap
+
     def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
         if not cadence_crossed(step, self.every_steps, self._last):
             return
         self._last = step
         snap = self._snapshot()
-        if snap is not None and snap != self._exported:
-            self._exported = snap
+        if snap is None:
+            return
+        key = self._gate(snap)
+        if key != self._exported:
+            self._exported = key
             self.writer.write_event(self.event, {"step": int(step),
                                                  **snap})
 
@@ -367,6 +377,77 @@ class CommOverlapHook(_SnapshotExportHook):
             # schedule cross-check reads it straight off overlap_stats
             snap.pop("declared_collectives", None)
         return snap
+
+
+class CommTimingHook(_SnapshotExportHook):
+    """Export the MEASURED per-bucket exchange timings (utils.metrics.
+    comm_timing_stats, fed once per process by parallel/overlap.
+    probe_comm_plan) as ``{"event": "comm_timing"}`` rows, JOINED with a
+    live per-step wall-time estimate measured between this hook's own
+    cadence firings — the runtime attribution ``main.py comm-report``
+    reduces against the static collective schedule
+    (docs/observability.md). The probe data is static per run, so the
+    ``_gate`` override quantizes the live rate to 2 significant digits:
+    rows re-export when the measured step time MOVES, not per wiggle."""
+
+    event = "comm_timing"
+
+    def __init__(self, writer: MetricsWriter, every_steps: int = 100):
+        super().__init__(writer, every_steps)
+        self._rate_prev: Optional[tuple] = None  # (monotonic, step)
+        self._pending_step = 0
+
+    def reset_window(self) -> None:
+        """Called by Trainer.train at segment start (the LoggingHook
+        protocol): a rate pair spanning the eval/checkpoint pause between
+        segments would inflate step_secs and understate the
+        comm_step_ratio headroom."""
+        self._rate_prev = None
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        self._pending_step = step  # _snapshot's rate-pair endpoint
+        super().__call__(step, state, metrics)
+
+    def _snapshot(self):
+        now = time.monotonic()
+        step = self._pending_step
+        prev, self._rate_prev = self._rate_prev, (now, step)
+        from ..utils.metrics import comm_timing_stats
+        snap = comm_timing_stats.snapshot()
+        if snap is None:
+            return None  # the probe has not run (overlap off / knob off)
+        if prev is not None and step > prev[1] and now > prev[0]:
+            step_secs = (now - prev[0]) / (step - prev[1])
+            snap["step_secs"] = round(step_secs, 6)
+            snap["comm_step_ratio"] = round(
+                snap["comm_secs_total"] / step_secs, 4)
+        return snap
+
+    def _gate(self, snap):
+        gate = dict(snap)
+        if "step_secs" in gate:
+            gate["step_secs"] = float(f"{gate['step_secs']:.2g}")
+            gate.pop("comm_step_ratio", None)
+        return gate
+
+
+class MemoryHook(_SnapshotExportHook):
+    """Export the device/host memory sample (telemetry/memory.py:
+    per-device live-array bytes + allocator stats where present, host
+    RSS, echo-cache and staging-ring occupancy) as ``{"event": "memory"}``
+    rows every N steps — the trend line that turns an OOM from a
+    postmortem into a graph. Runs on EVERY process (each host samples its
+    own devices; non-chief processes export into their per-process
+    ``train-p<idx>`` stream, which ``main.py monitor`` rolls up into the
+    per-host HBM watermark). Samples change between cadences, so the
+    skeleton's change-gate passes and the rows form a time series — for
+    memory that is the point, not noise."""
+
+    event = "memory"
+
+    def _snapshot(self):
+        from ..telemetry.memory import sample_memory
+        return sample_memory()
 
 
 class CorruptRecordsHook(_CadenceHook):

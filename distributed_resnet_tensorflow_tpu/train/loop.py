@@ -507,6 +507,12 @@ class Trainer:
         self._jitted_idx = None
         self._jitted_idx_multi = None
         self.state: Optional[TrainState] = None
+        # per-collective runtime attribution (telemetry.comm_timing):
+        # one standalone timing pass over the bucketed-exchange plan per
+        # process, fired at the first loop boundary after the plan traces
+        # (parallel/overlap.probe_comm_plan; every process participates —
+        # the probe runs collectives)
+        self._comm_probed = False
         # optional resilience/heartbeat.HeartbeatPublisher (set by
         # main.run_train when the watchdog is enabled): evaluate() ticks it
         # per eval batch so hang detection stays live outside the train
@@ -955,6 +961,24 @@ class Trainer:
         if self.state is not None:
             self.state = self.state.replace(tx=self.tx)
 
+    def _maybe_probe_comm(self) -> None:
+        """Run the per-bucket collective timing probe ONCE per process,
+        the first time the bucketed exchange's plan is available
+        (parallel/overlap.probe_comm_plan → utils.metrics.
+        comm_timing_stats → the chief's comm_timing rows). Called at step
+        dispatch boundaries; every process reaches the same boundary in
+        the same order, so the probe's collectives are SPMD-safe. Must
+        never kill training — the probe itself swallows measurement
+        errors."""
+        if self._comm_probed or not self.comm_overlap_active \
+                or not self.cfg.telemetry.comm_timing:
+            return
+        from ..parallel.overlap import overlap_stats, probe_comm_plan
+        if overlap_stats.snapshot() is None:
+            return  # the step has not traced yet
+        self._comm_probed = True
+        probe_comm_plan(self.mesh, reps=self.cfg.telemetry.comm_timing_reps)
+
     # -- loops -------------------------------------------------------------
     def train(self, data_iter: Iterator, num_steps: Optional[int] = None,
               hooks: Tuple = (), start_step: int = 0,
@@ -1048,6 +1072,7 @@ class Trainer:
                 batch_uses -= 1
                 with span("train.step"):
                     self.state, metrics = step_fn(self.state, batch)
+                self._maybe_probe_comm()
                 for h in hooks:
                     h(step + 1, self.state, metrics)
                 if stop_fn is not None and stop_fn():
@@ -1091,6 +1116,7 @@ class Trainer:
                 b = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
                 with span("train.step"):
                     self.state, metrics = step_fn(self.state, b)
+                self._maybe_probe_comm()
                 step += 1
                 for h in hooks:
                     h(step, self.state, metrics)
@@ -1122,6 +1148,7 @@ class Trainer:
                     break
                 with span("train.step"):
                     self.state, metrics = multi_fn(self.state, stacked)
+                self._maybe_probe_comm()
                 step += k
                 for h in hooks:
                     h(step, self.state, metrics)

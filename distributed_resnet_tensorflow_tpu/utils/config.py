@@ -448,6 +448,46 @@ class TelemetryConfig:
     # week-long serve/monitor run must not fill the disk. 0 MB = unbounded
     metrics_max_mb: float = 256.0
     metrics_max_segments: int = 4
+    # -- per-collective runtime attribution (parallel/overlap.py probe) --
+    # once per process, after the bucketed exchange has traced, time each
+    # planned bucket's collective standalone on the live mesh (wire
+    # dtype/bytes) — the measured side of the comm_timing row and
+    # `main.py comm-report`. Cost: a handful of tiny collective programs
+    # at the first loop boundary; every process participates (the probe
+    # is SPMD), the chief records. Off = plan-only telemetry.
+    comm_timing: bool = True
+    # number of timed repetitions per bucket (best-of)
+    comm_timing_reps: int = 3
+    # -- device-memory telemetry (telemetry/memory.py) -------------------
+    # sample per-device live-array bytes (+ allocator stats where the
+    # backend reports them), host RSS, echo-cache and staging-ring
+    # occupancy into {"event": "memory"} rows at the summary cadence
+    # (train loop) and the serve report cadence. `main.py monitor` rolls
+    # the per-host HBM watermark up. Off = no memory rows.
+    memory: bool = True
+    # -- perf-anomaly sentinel (resilience/watchdog.py) ------------------
+    # online step-time outlier detection over a rolling median+MAD
+    # window: a slow-but-alive step (no hang, no teardown) triggers a
+    # {"event": "perf_anomaly"} row + the flight-recorder dump — today's
+    # 2×-slow step should page like a hang does, not wait for the wall
+    # clock. Rides the watchdog's detection thread, so it arms with the
+    # watchdog (resilience.watchdog.enabled).
+    anomaly_detection: bool = True
+    # rolling window of per-step-time samples the median/MAD come from
+    anomaly_window: int = 32
+    # minimum samples before the detector arms (a cold window's MAD is
+    # noise)
+    anomaly_min_samples: int = 16
+    # outlier threshold: median + max(anomaly_mad_k × MAD,
+    # (anomaly_min_ratio − 1) × median). The MAD term adapts to the
+    # run's jitter; the ratio floor keeps an ultra-steady run (MAD ~ 0)
+    # from flagging micro-hiccups.
+    anomaly_mad_k: float = 6.0
+    anomaly_min_ratio: float = 1.5
+    # minimum gap between fired anomalies (a persistently slow host must
+    # not dump a trace per detection tick); the episode also re-arms only
+    # after a healthy sample
+    anomaly_cooldown_secs: float = 60.0
 
 
 @dataclass

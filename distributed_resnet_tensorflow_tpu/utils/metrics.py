@@ -229,6 +229,46 @@ class CkptAsyncStats:
 ckpt_async_stats = CkptAsyncStats()
 
 
+class CommTimingStats:
+    """Thread-safe record of the MEASURED per-bucket collective timings
+    (parallel/overlap.probe_comm_plan): the runtime companion to the
+    static bucket plan in ``overlap_stats``. The probe times each planned
+    gradient-exchange bucket's collective standalone (wire dtype, wire
+    bytes) once per process, so the ``{"event": "comm_timing"}`` row and
+    ``main.py comm-report`` can attribute achieved bytes/sec to
+    INDIVIDUAL buckets instead of one aggregate ratio
+    (docs/observability.md)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._probe: Optional[Dict[str, Any]] = None
+
+    def record(self, buckets, comm_secs_total: float, reps: int,
+               axes, compress: str) -> None:
+        with self._lock:
+            self._probe = {
+                "buckets": [dict(b) for b in buckets],
+                "comm_secs_total": round(float(comm_secs_total), 6),
+                "reps": int(reps),
+                "axes": list(axes),
+                "compress": compress,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._probe = None
+
+    def snapshot(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return None if self._probe is None else {
+                k: (list(v) if isinstance(v, list) else v)
+                for k, v in self._probe.items()}
+
+
+# process-global measured bucket timings (one probed plan per process)
+comm_timing_stats = CommTimingStats()
+
+
 #: The metrics.jsonl event registry — the ONE source of truth for every
 #: typed ``{"event": <name>, ...}`` record any part of the framework may
 #: emit. Each entry: {"fields": {field: one-line description},
@@ -365,6 +405,79 @@ EVENT_SCHEMAS = {
                                  "halved under bf16/fp16 on the SAME "
                                  "bucket plan)",
             "wire_bytes": "total wire bytes per step exchange",
+        },
+    },
+    "comm_timing": {
+        "emitted_by": "train/hooks.py CommTimingHook (chief; once the "
+                      "per-bucket collective probe has run — "
+                      "parallel/overlap.probe_comm_plan)",
+        "fields": {
+            "step": "step at export time",
+            "buckets": "per-bucket measured attribution, issue order: "
+                       "{bucket, bytes, wire_bytes, leaves, probe_secs, "
+                       "wire_bytes_per_sec} — probe_secs is the bucket's "
+                       "collective timed STANDALONE on the live mesh "
+                       "(wire dtype/bytes), not its in-step exposed time",
+            "comm_secs_total": "sum of the per-bucket standalone times — "
+                               "what the exchange would cost fully "
+                               "exposed",
+            "reps": "timed repetitions per bucket (best-of)",
+            "axes": "mesh axes the probed collective reduces over",
+            "compress": "wire dtype the probe used (comm.compress; off "
+                        "= f32)",
+            "step_secs": "measured wall seconds per optimizer step over "
+                         "the hook's window (loop-boundary cadence "
+                         "pairs)",
+            "comm_step_ratio": "comm_secs_total / step_secs — the share "
+                               "of each step the exchange would cost if "
+                               "NOTHING were overlapped (the overlap "
+                               "headroom; docs/observability.md)",
+        },
+    },
+    "memory": {
+        "emitted_by": "train/hooks.py MemoryHook (every process, summary "
+                      "cadence) + serve/server.py (every 50 dispatch "
+                      "batches and at close)",
+        "fields": {
+            "step": "step at export time (serving: checkpoint step)",
+            "process": "jax.process_index() of the exporting host",
+            "devices": "per-local-device {live_bytes, live_peak_bytes} "
+                       "from jax.live_arrays(), plus the allocator's "
+                       "{bytes_in_use, peak_bytes_in_use, bytes_limit} "
+                       "where the backend reports memory_stats() (TPU; "
+                       "absent on CPU)",
+            "live_bytes_total": "live jax.Array bytes across this "
+                                "process's devices at sample time",
+            "live_peak_bytes_total": "high-water of live_bytes_total "
+                                     "over the run's samples (a SAMPLED "
+                                     "watermark — peaks between samples "
+                                     "are invisible; the allocator peak "
+                                     "is authoritative where present)",
+            "host_rss_bytes": "this process's resident set size",
+            "host_peak_rss_bytes": "VmHWM — the process's RSS high-water",
+            "echo_cache_bytes": "decoded-sample echo cache occupancy "
+                                "(data/echo.py; 0 when echoing is off)",
+            "echo_cache_cap_bytes": "configured echo cache byte bound",
+            "staging_ring_slots": "CoalescedStager host-ring slots across "
+                                  "live stagers (parallel/sharding.py)",
+            "staging_ring_inflight": "ring slots with an in-flight H2D "
+                                     "transfer at sample time",
+        },
+    },
+    "perf_anomaly": {
+        "emitted_by": "resilience/watchdog.py (perf-anomaly sentinel: "
+                      "median+MAD step-time outlier over the rolling "
+                      "window; telemetry.anomaly_* knobs)",
+        "fields": {
+            "step": "last completed step when the outlier fired",
+            "detail": "human-readable verdict",
+            "step_secs": "the outlying per-step time",
+            "median_secs": "rolling-window median per-step time",
+            "mad_secs": "rolling-window median absolute deviation",
+            "threshold_secs": "median + max(anomaly_mad_k × MAD, "
+                              "(anomaly_min_ratio − 1) × median) — what "
+                              "the sample exceeded",
+            "window": "samples in the rolling window at detection",
         },
     },
     "precision": {
@@ -505,7 +618,8 @@ EVENT_SCHEMAS = {
                       "exits)",
         "fields": {
             "reason": "what triggered the dump (hang | peer_lost | "
-                      "peer_failed | straggler | exception | on_demand)",
+                      "peer_failed | straggler | perf_anomaly | "
+                      "exception | on_demand)",
             "detail": "human-readable trigger detail",
             "path": "trace.json written (Chrome-trace / Perfetto format)",
             "spans": "events in the ring at dump time",
@@ -771,3 +885,26 @@ def read_metrics(logdir: str, filename: str = "metrics.jsonl",
                     if not tolerant:
                         raise
     return out
+
+
+def metric_stream_dirs(root: str, filename: str = "metrics.jsonl"):
+    """Every directory holding a metrics stream under ``root`` (the root
+    itself included — ``**`` matches zero directories) — the ONE
+    stream-layout discovery the monitor and the offline reducers share;
+    fix the layout here and every consumer follows. Readers differ on
+    purpose: the monitor tails a bounded window per frame, the reducers
+    read whole streams."""
+    import glob as _glob
+    return sorted({os.path.dirname(p) for p in _glob.glob(
+        os.path.join(root, "**", filename), recursive=True)})
+
+
+def iter_metric_streams(root: str, filename: str = "metrics.jsonl"):
+    """Yield the rows of every metrics stream under ``root``, tolerant
+    of torn lines and vanished files — the offline reducers' read path
+    (`main.py trace-merge` / `comm-report`)."""
+    for d in metric_stream_dirs(root, filename):
+        try:
+            yield read_metrics(d, filename=filename, tolerant=True)
+        except OSError:
+            continue

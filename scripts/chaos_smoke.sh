@@ -31,6 +31,13 @@ if [[ "${1:-}" == "--fast" ]]; then
   # die here, in seconds, not on the cluster. ANALYSIS_GATE_ARGS
   # passes through (e.g. --no-hangcheck, mirroring --no-zero1-sweep)
   scripts/analysis_gate.sh ${ANALYSIS_GATE_ARGS:-}
+  # opt-in observability stage (OBS_SMOKE=1): the slow-peer perf-anomaly
+  # + trace-merge + comm-report end-to-end (scripts/obs_smoke.sh, ~2 min
+  # of live 2-process training — too heavy for the default seconds-fast
+  # gate, which is why it is opt-in)
+  if [[ "${OBS_SMOKE:-0}" == "1" ]]; then
+    scripts/obs_smoke.sh
+  fi
 fi
 
 # ${arr[@]+...} form: bash <4.4 trips set -u on expanding an empty array

@@ -598,6 +598,30 @@ def test_env_slow_fault_unscoped_applies_everywhere(monkeypatch):
     assert naps == [0.1, 0.1, 0.1]
 
 
+def test_env_slow_fault_late_onset_form(monkeypatch):
+    """``S@N`` delays only from batch N on — the healthy-baseline-then-
+    slow-regime shape the perf-anomaly sentinel detects (ISSUE 14)."""
+    naps = []
+    monkeypatch.setattr(faultinject.time, "sleep",
+                        lambda s: naps.append(s))
+    batches = [{"x": i} for i in range(5)]
+    it = faultinject.maybe_wrap_from_env(
+        iter(batches), env={faultinject.SLOW_ENV_VAR: "0.2@4"})
+    assert list(it) == batches
+    assert naps == [0.2, 0.2]  # batches 4 and 5 only
+    assert faultinject._parse_slow("0.5") == (0.5, 1)
+    assert faultinject._parse_slow("0.5@12") == (0.5, 12)
+    with pytest.raises(ValueError):
+        faultinject._parse_slow("junk@3")
+    with pytest.raises(ValueError):
+        faultinject._parse_slow("0.5@0")  # from_batch is 1-based
+    # malformed values disarm loudly instead of crashing the run
+    naps.clear()
+    it = faultinject.maybe_wrap_from_env(
+        iter(batches), env={faultinject.SLOW_ENV_VAR: "oops"})
+    assert list(it) == batches and naps == []
+
+
 def test_env_nan_injection_hook(monkeypatch):
     batches = [{"images": np.ones((2, 2), np.float32),
                 "labels": np.zeros((2,), np.int32)} for _ in range(3)]
