@@ -875,7 +875,113 @@ def _overlap_ab(n_steps: int = 20):
                     "error": f"{type(e).__name__}: {e}"[:200]}
     rows["bucketed_vs_off"] = round(
         rows["bucketed"]["steps_per_sec"] / rows["off"]["steps_per_sec"], 3)
+    rows["families"] = _overlap_family_sweep()
     return rows
+
+
+def _overlap_family_sweep(n_steps: int = 4):
+    """The universal-envelope family sweep (ISSUE 15): comm.overlap
+    off/on steps/s AND per-step wire bytes for one leg per newly
+    in-envelope family — conv dp (the PR-10 baseline leg rides above),
+    vit dp_tp (partial-auto tensor), MoE dp_pp_ep (inline pipeline,
+    per-expert-group buckets) and conv dp with grad_accum_steps=4 (the
+    scan inside the body: wire/step must stay 1× the gradient bytes,
+    i.e. shrink by exactly the accumulation factor vs a per-microbatch
+    exchange). On virtual CPU devices collectives are memcpys, so
+    steps/s mostly witnesses structure; wire accounting is exact
+    everywhere."""
+    from distributed_resnet_tensorflow_tpu.parallel import create_mesh
+    from distributed_resnet_tensorflow_tpu.parallel.overlap import (
+        overlap_stats)
+    from distributed_resnet_tensorflow_tpu.parallel.sharding import (
+        shard_batch)
+    from distributed_resnet_tensorflow_tpu.train import Trainer
+    from distributed_resnet_tensorflow_tpu.utils.config import (MeshConfig,
+                                                                get_preset)
+
+    def vit_cfg(experts=0):
+        cfg = get_preset("smoke")
+        cfg.model.name = "vit"
+        cfg.model.num_classes = 10
+        cfg.model.vit_patch_size = 4
+        cfg.model.vit_dim = 32
+        cfg.model.vit_depth = 4
+        cfg.model.vit_heads = 2
+        cfg.model.vit_num_experts = experts
+        cfg.data.image_size = 16
+        cfg.optimizer.name = "adam"
+        return cfg
+
+    def conv_cfg():
+        cfg = get_preset("cifar10_resnet50")
+        cfg.model.resnet_size = 8
+        return cfg
+
+    n_dev = len(jax.devices())
+    legs = {
+        "vit_dp_tp": (vit_cfg(), MeshConfig(data=max(2, n_dev // 2),
+                                            tensor=2)),
+        "moe_dp_pp_ep": (vit_cfg(experts=2),
+                         MeshConfig(data=max(1, n_dev // 4), pipeline=2,
+                                    expert=2)),
+        "conv_dp_accum4": (conv_cfg(), MeshConfig(data=n_dev)),
+    }
+    rng = np.random.RandomState(0)
+    out = {}
+    for leg, (cfg0, mesh_cfg) in legs.items():
+        row = {}
+        for mode in ("off", "on"):
+            try:
+                import copy
+                cfg = copy.deepcopy(cfg0)
+                cfg.train.batch_size = 64
+                cfg.train.grad_accum_steps = 4 if "accum" in leg else 1
+                cfg.comm.overlap = mode
+                cfg.comm.bucket_mb = 0.25
+                cfg.checkpoint.save_every_secs = 0.0
+                cfg.mesh = mesh_cfg
+                overlap_stats.reset()
+                trainer = Trainer(cfg)
+                trainer.init_state()
+                s = cfg.data.image_size
+                images = rng.randn(64, s, s, 3).astype(np.float32)
+                labels = rng.randint(0, 10, (64,)).astype(np.int32)
+                batch = shard_batch({"images": images, "labels": labels},
+                                    trainer.mesh)
+                step_fn = trainer.jitted_train_step()
+                state = trainer.state
+                for _ in range(2):  # compile + warm
+                    state, _m = step_fn(state, batch)
+                jax.block_until_ready(state.params)
+                state, dt = _best_time(step_fn, state, [batch], n_steps,
+                                       reps=1)
+                row[mode] = {
+                    "steps_per_sec": round(n_steps / dt, 2),
+                    "step_ms": round(dt / n_steps * 1000, 2),
+                }
+                if mode == "on":
+                    plan = overlap_stats.snapshot()
+                    row[mode].update({
+                        "wire_bytes_per_step": plan["wire_bytes"],
+                        "grad_bytes": plan["grad_bytes"],
+                        "buckets": plan["buckets"],
+                        "bucket_reduce_axes": sorted(
+                            set(plan["bucket_reduce_axes"])),
+                        "accum_steps": plan["accum_steps"],
+                        # what a per-microbatch exchange would have moved
+                        # per optimizer step — the accumulation saving's
+                        # denominator
+                        "wire_bytes_per_step_unfused":
+                            plan["wire_bytes"] * plan["accum_steps"],
+                    })
+            except Exception as e:
+                row[mode] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        if "steps_per_sec" in row.get("on", {}) and \
+                "steps_per_sec" in row.get("off", {}):
+            row["on_vs_off"] = round(row["on"]["steps_per_sec"] /
+                                     row["off"]["steps_per_sec"], 3)
+        out[leg] = row
+    return out
 
 
 def bench_zero1(budget_left):
@@ -1093,9 +1199,11 @@ def bench_serving(budget_left):
     cfg.mesh.data = len(jax.devices())
     cfg.serve.max_queue_delay_ms = 2.0
     # (batch, variant) buckets (docs/precision.md): the same replica
-    # carries the f32 oracle AND a bf16 weight/compute variant; the row
-    # drives one open loop per variant so p50/p99/QPS read per dtype
-    cfg.serve.variants = ("f32", "bf16")
+    # carries the f32 oracle, a bf16 weight/compute variant AND the int8
+    # weight-only variant (per-channel-quantized kernels dequantized into
+    # an f32 forward); the row drives one open loop per variant so
+    # p50/p99/QPS read per dtype
+    cfg.serve.variants = ("f32", "bf16", "int8")
     cfg.checkpoint.directory = os.path.join(
         tempfile.gettempdir(), "drt_bench_serve_empty_ckpt")  # no ckpt:
     # serving fresh-init params — the row times the serving path, not

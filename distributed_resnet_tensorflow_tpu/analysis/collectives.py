@@ -180,8 +180,16 @@ def _abstract_state(trainer, cfg):
     from ..train.state import abstract_train_state
     from ..parallel.mesh import batch_shard_count
     nb = batch_shard_count(trainer.mesh)
+    # the memoized state embeds apply_fn — a module bound to ITS mesh.
+    # Shaping axes bake into the module's program (pipeline microbatching,
+    # the exchange-inline local param shapes), so two layouts may share a
+    # state only when their full shaping signature matches; keying on the
+    # batch-shard count alone handed dp_pp_ep a dp_pp-meshed apply_fn
+    # (same nb=2) and the exchange-inline flax shape check caught it
     key = repr((dataclasses.asdict(cfg.model), cfg.optimizer.name,
-                cfg.data.dataset, cfg.data.image_size, nb))
+                cfg.data.dataset, cfg.data.image_size, nb,
+                tuple(trainer.mesh.shape.get(a, 1)
+                      for a in ("pipeline", "tensor", "expert", "seq"))))
     state = _STATE_MEMO.get(key)
     if state is None:
         state = abstract_train_state(
@@ -362,6 +370,40 @@ def run_collectives(preset_names: Optional[Sequence[str]] = None,
                            deterministic_retrace=(label == "dp_fsdp"
                                                   and name == _DET_PROBE),
                            plan_check=True)
+
+                # the accumulation composition (the scan inside the
+                # exchange body, ONE bucketed exchange per optimizer
+                # step): its schedule is the family's witness that wire
+                # traffic is 1× per step — the scan body carries no
+                # exchange collectives, the declared bucket plan follows
+                # it. ONE witness per model family (the conv det-probe on
+                # both batch layouts — dp_fsdp adds the scatter+accum
+                # composition — and the smallest transformer preset):
+                # per-preset accum traces re-record the identical bucket
+                # plan and doubled the phase's cost AND the committed
+                # artifact for the big presets.
+                if not shaping and name in (_DET_PROBE, "vit_moe"):
+                    accum = 4 if cfg.train.batch_size % (n * 4) == 0 \
+                        else (2 if cfg.train.batch_size % (n * 2) == 0
+                              else 0)
+                    if accum and not dedupe(
+                            "overlap_accum", cfg, label,
+                            (cfg.comm.bucket_mb, accum)):
+
+                        def build_accum(cfg=cfg, mesh=mesh, accum=accum):
+                            acfg = copy.deepcopy(cfg)
+                            acfg.comm.overlap = "on"
+                            acfg.train.grad_accum_steps = accum
+                            trainer = _trainer_for(acfg, mesh)
+                            state = _abstract_state(trainer, cfg)
+                            batch = _abstract_batch(
+                                acfg, acfg.train.batch_size)
+                            return extract_schedule(trainer._train_step,
+                                                    state, batch)
+
+                        record(name, label, f"overlap+accum{accum}",
+                               build_accum, deterministic_retrace=False,
+                               plan_check=True)
 
                 # (3) the full low-precision composition: bf16 step ×
                 # bucketed exchange × compressed payload — wire bytes in

@@ -297,9 +297,10 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
         # abstractly — a shard_map spec/rank error, a bucket plan that
         # cannot exchange a leaf, or a BN-axis mistake is a gate finding
         # here, not a step-1 crash when an operator first flips the knob
-        # on a cluster. Only layouts inside the overlap envelope trace
-        # (dp / dp_fsdp on the conv/logistic families); the state shapes
-        # are reused — the axis-named model has an identical param tree.
+        # on a cluster. The layout-aware envelope covers the transformer
+        # family too (dp_tp / dp_pp / dp_pp_ep trace their partial-auto /
+        # inline-pipeline exchanges); the state shapes are reused — the
+        # axis-named model has an identical param tree.
         try:
             import copy
             from ..parallel.overlap import overlap_unsupported_reason
@@ -313,6 +314,31 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
         except Exception as e:
             findings.append(_findings_from_exc("elab-overlap-step", locus,
                                                "bucketed overlap step", e))
+
+        # the gradient-accumulation composition: the scan runs INSIDE
+        # the exchange body (one bucketed exchange per optimizer step),
+        # so its trace is a different program than the plain overlap
+        # step. One accum factor per preset, on its batch-only layout —
+        # the shaped layouts share the body machinery just traced above.
+        try:
+            import copy
+            from ..parallel.overlap import overlap_unsupported_reason
+            shaped = any(mesh.shape.get(a, 1) > 1
+                         for a in ("pipeline", "tensor", "expert", "seq"))
+            if trace_comm_variants and not shaped:
+                acfg = copy.deepcopy(cfg)
+                acfg.comm.overlap = "on"
+                acfg.train.grad_accum_steps = 4 if cfg.train.batch_size \
+                    % (batch_shard_count(mesh) * 4) == 0 else 2
+                if overlap_unsupported_reason(acfg, mesh) is None:
+                    atrainer = Trainer(acfg, mesh=mesh)
+                    batch = _abstract_batch(acfg, acfg.train.batch_size)
+                    jax.eval_shape(atrainer._train_step, state_shapes,
+                                   batch)
+        except Exception as e:
+            findings.append(_findings_from_exc(
+                "elab-overlap-step", locus,
+                "bucketed overlap + accumulation step", e))
 
         # bf16 precision-policy step (parallel/precision.py): the
         # train.precision=bf16 variant of this preset × layout, traced
@@ -346,22 +372,25 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
                 batch = _abstract_batch(pcfg, pcfg.train.batch_size)
                 jax.eval_shape(ptrainer._train_step, state_shapes, batch)
                 if trace_forward:
-                    # the serving bf16 VARIANT forward, one bucket is
-                    # enough (the dtype path is bucket-independent) —
-                    # traced over the CAST abstract state, exactly what
-                    # ServeCompileCache compiles the variant against
-                    from ..parallel.precision import (
-                        SERVE_VARIANT_DTYPES, make_variant_cast)
-                    vstep = ptrainer.make_variant_predict_step(
-                        SERVE_VARIANT_DTYPES["bf16"])
-                    vstate = jax.eval_shape(make_variant_cast("bf16"),
-                                            state_shapes)
+                    # the serving reduced-precision VARIANT forwards,
+                    # one bucket each (the dtype path is
+                    # bucket-independent) — traced over the CAST
+                    # abstract state, exactly what ServeCompileCache
+                    # compiles each variant against. "bf16" covers the
+                    # cast-dtype path, "int8" the weight-only
+                    # quantize/dequantize path (marker-dict param tree)
+                    from ..parallel.precision import make_variant_cast
                     pad_to = ptrainer.eval_pad_multiple()
                     from ..serve.server import serve_image_spec
                     vshape, vdtype = serve_image_spec(pcfg)
                     vbatch = {"images": jax.ShapeDtypeStruct(
                         (pad_to,) + vshape, vdtype)}
-                    jax.eval_shape(vstep, vstate, vbatch)
+                    for variant in ("bf16", "int8"):
+                        vstep = ptrainer.make_variant_predict_step(
+                            variant)
+                        vstate = jax.eval_shape(
+                            make_variant_cast(variant), state_shapes)
+                        jax.eval_shape(vstep, vstate, vbatch)
                 if trace_comm_variants and \
                         overlap_unsupported_reason(pcfg, mesh) is None:
                     # bf16 step × bucketed exchange × compressed payload

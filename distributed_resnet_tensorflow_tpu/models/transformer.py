@@ -25,16 +25,27 @@ from jax.sharding import PartitionSpec as P
 
 
 def _batch_axes(mesh) -> tuple:
-    from ..parallel.mesh import present_batch_axes
-    return present_batch_axes(mesh)
+    """Present batch axes, MINUS any the enclosing exchange shard_map
+    already maps manually (parallel/overlap.py: inside its body the batch
+    is per-shard local — re-splitting or constraining over those axes
+    would be wrong/illegal)."""
+    from ..parallel.mesh import current_manual_axes, present_batch_axes
+    manual = current_manual_axes()
+    return tuple(a for a in present_batch_axes(mesh) if a not in manual)
 
 
 def _constrain(x: jax.Array, mesh, spec: "P") -> jax.Array:
     """with_sharding_constraint when a mesh is attached (no-op otherwise) —
-    pins GSPMD's layout choice at the block boundaries."""
+    pins GSPMD's layout choice at the block boundaries. Axes the
+    enclosing exchange body maps manually are filtered out of the spec
+    (only auto axes may be constrained there)."""
     if mesh is None:
         return x
     from jax.sharding import NamedSharding
+    from ..parallel.mesh import filter_manual_spec
+    spec = filter_manual_spec(spec)
+    if not any(s is not None for s in spec):
+        return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 

@@ -115,7 +115,8 @@ def batch_shard_count(mesh: Mesh) -> int:
     return mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
 
 
-def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs,
+                     auto: frozenset = frozenset()):
     """``jax.shard_map`` across jax versions, replication checks off.
 
     One home for two version dances every caller needs: the import moved
@@ -123,16 +124,90 @@ def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
     removed), and the don't-check-replication flag was renamed
     ``check_rep`` → ``check_vma``. Checks stay off because our shard_map
     bodies wrap collectives/pallas_call, which don't declare varying-mesh
-    -axes info."""
+    -axes info.
+
+    ``auto``: mesh axes left AUTOMATIC (GSPMD propagation inside the
+    body, like under plain jit) while the rest go manual — the
+    partial-manual form the layout-aware gradient exchange uses for the
+    propagation-parallel ``tensor`` axis (parallel/overlap.py): specs may
+    only name manual axes; values keep their auto-axis sharding."""
     try:
         from jax import shard_map
     except ImportError:  # pragma: no cover - jax < 0.8
         from jax.experimental.shard_map import shard_map
     kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if auto:
+        kwargs["auto"] = frozenset(auto)
     try:
         return shard_map(fn, check_vma=False, **kwargs)
     except TypeError:  # pragma: no cover - older jax spells it check_rep
         return shard_map(fn, check_rep=False, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Manual-axes trace context: how model code learns it is running INSIDE a
+# manually-mapped shard_map body (the layout-aware gradient exchange,
+# parallel/overlap.make_bucketed_grad) rather than under plain jit.
+# Sharding constraints naming a manual axis are illegal inside the body,
+# model-internal shard_maps must not re-map an already-manual axis (jax
+# 0.4.37 mis-transposes nested shard_map over auto axes — measured, see
+# overlap.py), and per-shard batch math must stop dividing by shards the
+# enclosing body already split. The context is TRACE-time only: the body
+# runs during jit tracing, so its dynamic extent covers exactly the model
+# code whose behavior must flip.
+# ---------------------------------------------------------------------------
+
+_MANUAL_AXES = threading.local()
+
+
+def current_manual_axes() -> frozenset:
+    """Mesh axes the innermost enclosing exchange shard_map maps manually
+    (empty outside one)."""
+    return getattr(_MANUAL_AXES, "axes", frozenset())
+
+
+class manual_axes:
+    """Context manager declaring ``axes`` manually mapped for the model
+    code traced inside it (parallel/overlap.py wraps the loss body)."""
+
+    def __init__(self, axes):
+        self.axes = frozenset(axes)
+
+    def __enter__(self):
+        self._prev = current_manual_axes()
+        _MANUAL_AXES.axes = self.axes
+        return self.axes
+
+    def __exit__(self, *exc):
+        _MANUAL_AXES.axes = self._prev
+        return False
+
+
+def filter_spec_axes(spec: P, keep) -> P:
+    """PartitionSpec entry filter: keep only axis names for which
+    ``keep(name)`` is True, collapsing entries back to
+    name / tuple / ``None`` — the ONE home of that normalization, shared
+    by the manual-context constraint filter below and the exchange's
+    manual/auto spec splits (parallel/overlap.py)."""
+    out = []
+    for names in spec:
+        if names is None:
+            out.append(None)
+            continue
+        tup = names if isinstance(names, tuple) else (names,)
+        kept = tuple(n for n in tup if keep(n))
+        out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return P(*out)
+
+
+def filter_manual_spec(spec: P) -> P:
+    """Drop manual-axis references from a PartitionSpec (constraints and
+    shard_map specs inside the exchange body may only name auto axes) —
+    axes already consumed by the enclosing manual map become ``None``."""
+    manual = current_manual_axes()
+    if not manual:
+        return spec
+    return filter_spec_axes(spec, lambda n: n not in manual)
 
 
 # weak-key memo: an lru_cache here would pin up to maxsize Mesh objects

@@ -40,12 +40,51 @@ result is allclose at the compressed dtype's rounding, by design. Local
 gradient accumulation and the optimizer update stay f32 — only the wire
 format narrows.
 
-Support envelope (``overlap_unsupported_reason``): batch-parallel meshes
-only (no pipeline/tensor/expert/seq axes — those bake their own
-shard_maps into the model), the conv/logistic families (the dp
-workhorses), no gradient accumulation, and — for BatchNorm models —
-cross-replica BN (the grouped per-replica-BN emulation has no shard_map
-wiring). ``comm.overlap=auto`` quietly stays off outside the envelope;
+Layout-aware exchange (the universal overlap envelope): the exchange is
+no longer batch-mesh-only. Per leaf, the reduce-axis set derives from
+the leaf's PartitionSpec — a tensor-/expert-/pipeline-sharded leaf keeps
+its shaping-axis placement and psums over the batch axes (plus any
+shaping axis it is REPLICATED over) only; leaves are bucketed BY
+reduce-axis set so one bucket's tuple-psum never mixes axis sets (the
+MoE expert leaves get their own buckets). Three mechanisms, one per
+parallelism style:
+
+  * ``tensor`` (Megatron via GSPMD propagation, dp_tp): left AUTO in a
+    partially-manual shard_map — constraints and the per-op collectives
+    keep riding propagation inside the body, exactly as under jit.
+  * ``pipeline`` (+``expert``: dp_pp, dp_pp_ep): mapped MANUALLY along
+    with the batch axes; the PipelinedEncoder detects the enclosing
+    manual map (parallel/mesh.manual_axes) and runs its schedule INLINE
+    — jax 0.4.37 mis-transposes a nested shard_map over auto axes
+    (measured: garbage cotangents), so the model's own shard_map must
+    not rebuild inside the body. The bucketed exchange then issues after
+    the pipeline's backward flush.
+  * gradient accumulation (``train.grad_accum_steps`` > 1): the
+    microbatch scan runs INSIDE the shard_map body accumulating LOCAL
+    f32 gradients, and ONE bucketed exchange fires after the final
+    microbatch — wire traffic per optimizer step drops from ``accum×``
+    (the per-microbatch exchange XLA propagation emits inside lax.scan)
+    to ``1×``, and the exchange overlaps the final microbatch's
+    backprop (the last microbatch is peeled out of the scan so its
+    backward is still in flight when the first buckets issue).
+
+Replicated-leaf calculus on shaped meshes: each peer's local loss
+contribution is scaled so the SUM over every manual peer equals the
+global loss (CE /R, decay/aux /(shards·R), R = product of non-batch
+manual axis sizes). Each leaf's local gradient is then the true partial
+derivative w.r.t. that peer's shard, and the exchange is uniformly
+"psum over the manual axes the leaf's spec does not name" — redundant
+compute (a head replicated across pipeline peers) and partial compute
+(a router fed through the expert all-to-all) need no case split.
+
+Support envelope (``overlap_unsupported_reason``): batch-parallel,
+tensor (unpipelined), pipeline and pipeline×expert meshes across the
+conv/logistic/transformer families, with or without gradient
+accumulation. Still refused, each with its precise reason: ``seq`` > 1
+(ring attention's shard_map nests), ``expert`` > 1 without a pipeline
+axis (SwitchMlp's a2a shard_map nests), ``tensor`` × ``pipeline``
+(auto axis inside a manual body), and per-replica BN on BatchNorm
+models. ``comm.overlap=auto`` quietly stays off outside the envelope;
 ``=on`` raises with the reason.
 """
 from __future__ import annotations
@@ -65,9 +104,52 @@ from ..telemetry.tracer import span
 
 log = logging.getLogger(__name__)
 
-#: the two batch axes the dp/dp_fsdp exchange reduces over (size-1 axes
-#: are no-ops; both always exist on a full mesh — parallel/mesh.AXES)
+#: the two batch axes every exchange reduces over (size-1 axes are
+#: no-ops; both always exist on a full mesh — parallel/mesh.AXES)
 BATCH_AXES = ("data", "fsdp")
+
+#: non-batch mesh axes, in the canonical parallel/mesh.AXES order —
+#: the candidates for manual shaping axes in the layout-aware exchange
+SHAPING_AXES = ("pipeline", "expert", "seq", "tensor")
+
+
+def overlap_auto_axes(mesh: Mesh) -> frozenset:
+    """Mesh axes the exchange shard_map leaves AUTOMATIC: ``tensor``,
+    whose Megatron placement rides GSPMD propagation +
+    with_sharding_constraint (models/transformer.py) rather than explicit
+    collectives — inside the body it keeps behaving exactly as under
+    jit. Everything else the envelope admits is manual."""
+    return frozenset({"tensor"}) if mesh.shape.get("tensor", 1) > 1 \
+        else frozenset()
+
+
+def overlap_shaping_axes(mesh: Mesh):
+    """Active (>1) non-batch axes the exchange maps MANUALLY, canonical
+    order — the axes whose redundancy factor scales the local loss and
+    whose names join replicated leaves' reduce sets."""
+    auto = overlap_auto_axes(mesh)
+    return tuple(a for a in SHAPING_AXES
+                 if a not in auto and mesh.shape.get(a, 1) > 1)
+
+
+def _spec_axis_names(spec: P) -> frozenset:
+    names = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        tup = entry if isinstance(entry, tuple) else (entry,)
+        names.update(tup)
+    return frozenset(names)
+
+
+def leaf_reduce_axes(spec: P, shaping) -> tuple:
+    """The psum axis set for one gradient leaf: always the batch axes,
+    plus every active shaping axis the leaf's spec does NOT name (a leaf
+    sharded over ``pipeline``/``expert`` already holds a distinct shard
+    per peer there — summing would corrupt it; a leaf replicated over
+    them carries a 1/R-scaled partial that the psum reconstructs)."""
+    named = _spec_axis_names(spec)
+    return BATCH_AXES + tuple(a for a in shaping if a not in named)
 
 
 #: dtypes the exchange payload may compress to (``comm.compress``) — the
@@ -116,7 +198,9 @@ class OverlapStats:
                bucket_leaves: Sequence[int], total_bytes: int,
                n_leaves: int, compress: Optional[str] = None,
                wire_bytes: Optional[Sequence[int]] = None,
-               declared: Optional[Sequence[Sequence[str]]] = None) -> None:
+               declared: Optional[Sequence[Sequence[str]]] = None,
+               reduce_axes: Optional[Sequence[str]] = None,
+               accum_steps: int = 1) -> None:
         with self._lock:
             self._plan = {
                 "buckets": len(bucket_sizes),
@@ -125,6 +209,16 @@ class OverlapStats:
                 "bucket_leaves": [int(n) for n in bucket_leaves],
                 "grad_bytes": int(total_bytes),
                 "leaves": int(n_leaves),
+                # layout-aware exchange: per-bucket reduce-axis set (one
+                # set per bucket by construction — the grouped planner)
+                # and the accumulation factor. Under accumulation the
+                # plan fires ONCE per optimizer step, so wire_bytes below
+                # is already the per-step number: 1/accum of what a
+                # per-microbatch exchange would move.
+                "bucket_reduce_axes": ["+".join(a) for a in reduce_axes]
+                if reduce_axes is not None
+                else ["+".join(BATCH_AXES)] * len(bucket_sizes),
+                "accum_steps": int(accum_steps),
                 # compressed-exchange payload accounting (comm.compress):
                 # the SAME bucket plan, narrower wire format — what the
                 # comm_compress metrics row and bench's precision row read
@@ -161,22 +255,29 @@ def overlap_unsupported_reason(cfg, mesh: Mesh) -> Optional[str]:
     n = batch_shard_count(mesh)
     if n <= 1:
         return "a single batch shard has no gradient exchange to bucket"
-    if cfg.train.batch_size % n:
+    accum = max(1, cfg.train.grad_accum_steps)
+    if cfg.train.batch_size % (n * accum):
+        per = f"{n} batch shards" if accum == 1 else \
+            (f"{n} batch shards × {accum} accumulation microbatches")
         return (f"train.batch_size={cfg.train.batch_size} does not divide "
-                f"over {n} batch shards — the shard_map'd exchange needs "
-                "equal per-shard batches")
-    for axis in ("pipeline", "tensor", "expert", "seq"):
-        if mesh.shape.get(axis, 1) > 1:
-            return (f"mesh axis {axis!r} > 1 shapes the step program with "
-                    "its own shard_map — the bucketed dp exchange covers "
-                    "data/fsdp-only meshes")
-    if cfg.model.name == "vit":
-        return ("the transformer family routes attention/MoE through its "
-                "own collectives; bucketed overlap covers the conv/"
-                "logistic dp workhorses")
-    if cfg.train.grad_accum_steps > 1:
-        return ("grad_accum_steps > 1 exchanges once per accumulated "
-                "batch inside lax.scan — not wired for bucketing")
+                f"over {per} — the shard_map'd exchange needs equal "
+                "per-shard (micro)batches")
+    if mesh.shape.get("seq", 1) > 1:
+        return ("mesh axis 'seq' > 1 runs ring attention's own shard_map "
+                "inside the blocks — the exchange body cannot contain it "
+                "(jax 0.4.37 mis-transposes nested shard_map over auto "
+                "axes); sequence parallelism stays on the XLA-propagation "
+                "exchange")
+    if mesh.shape.get("expert", 1) > 1 and mesh.shape.get("pipeline", 1) <= 1:
+        return ("mesh axis 'expert' > 1 without a pipeline axis routes "
+                "tokens through SwitchMlp's own (data,fsdp,expert) "
+                "shard_map — only the pipelined MoE form (dp_pp_ep, "
+                "models/pipeline._moe_mlp) runs inline in the exchange "
+                "body")
+    if mesh.shape.get("tensor", 1) > 1 and mesh.shape.get("pipeline", 1) > 1:
+        return ("tensor × pipeline is not wired into the exchange: "
+                "'tensor' rides GSPMD propagation as an AUTO axis, which "
+                "the manually-mapped pipeline body cannot contain")
     if cfg.model.name == "resnet" and cfg.model.norm == "batch" \
             and not cfg.model.cross_replica_bn:
         return ("per-replica BN (cross_replica_bn=false) is emulated with "
@@ -246,6 +347,39 @@ def plan_buckets(leaf_bytes: Sequence[int],
     return buckets
 
 
+def plan_buckets_grouped(leaf_bytes: Sequence[int],
+                         reduce_axes: Sequence[tuple],
+                         bucket_bytes: int):
+    """Greedy reverse-order bucketing, one open bucket PER reduce-axis
+    set: a bucket's replicated leaves ride a single tuple-psum over the
+    bucket's axes, so mixing sets in one bucket is ill-formed (the MoE
+    expert leaves — no ``expert`` in their reduce set — must not share a
+    tuple-psum with the router's ``…+expert`` set). Returns
+    ``[(axes, [leaf indices]), …]`` in ISSUE order: buckets sorted by the
+    reversed position of their first leaf, approximating backprop
+    availability exactly like :func:`plan_buckets` — to which this
+    degenerates (one group, same buckets, same order) on the batch-only
+    meshes, keeping their plans and artifacts unchanged."""
+    open_buckets: dict = {}
+    done: List[tuple] = []  # (first_leaf_reversed_pos, axes, [indices])
+    n = len(leaf_bytes)
+    for pos, i in enumerate(reversed(range(n))):
+        axes = tuple(reduce_axes[i])
+        cur = open_buckets.get(axes)
+        if cur is not None and cur[2] + leaf_bytes[i] > bucket_bytes:
+            done.append((cur[0], axes, cur[1]))
+            cur = None
+        if cur is None:
+            cur = [pos, [], 0]
+            open_buckets[axes] = cur
+        cur[1].append(i)
+        cur[2] += leaf_bytes[i]
+    for axes, cur in open_buckets.items():
+        done.append((cur[0], axes, cur[1]))
+    done.sort(key=lambda t: t[0])
+    return [(axes, idxs) for _, axes, idxs in done]
+
+
 def _fsdp_dim(spec: P) -> Optional[int]:
     """The dimension a PartitionSpec shards over ``fsdp``, or None."""
     return _axis_dim(spec, "fsdp")
@@ -273,22 +407,26 @@ def _param_specs(params: Any, mesh: Mesh):
                                   is_leaf=lambda x: hasattr(x, "spec"))
 
 
-def declared_bucket_collectives(specs, out_specs=None) -> List[str]:
+def declared_bucket_collectives(specs, out_specs=None,
+                                reduce_axes=BATCH_AXES) -> List[str]:
     """The collective-issue sequence ``_exchange_bucket`` will emit for
     one bucket, as ``"<kind>@<axis>[+<axis>…]"`` strings — the DECLARED
     plan hangcheck's schedule extractor (analysis/collectives.py) checks
     the traced jaxpr against: replicated leaves ride ONE tuple-psum over
-    both batch axes; each fsdp/ZeRO-sharded leaf reduce-scatters FIRST on
-    its sharded axis, then psums (or scatters) the remainder. Must mirror
+    the bucket's reduce-axis set (``reduce_axes`` — the batch axes plus
+    any shaping axes the leaves replicate over, parallel layouts); each
+    fsdp/ZeRO-sharded leaf reduce-scatters FIRST on its sharded axis,
+    then psums (or scatters) the remainder. Must mirror
     ``_exchange_bucket`` exactly — a drift between the two IS the gate
     finding."""
     if out_specs is None:
         out_specs = specs
+    reduce_axes = tuple(reduce_axes)
     ops: List[str] = []
     z1_dims = [_axis_dim(o, "data") for o in out_specs]
     if any(_fsdp_dim(s) is None and z1_dims[i] is None
            for i, s in enumerate(specs)):
-        ops.append("psum@" + "+".join(BATCH_AXES))
+        ops.append("psum@" + "+".join(reduce_axes))
     for i, spec in enumerate(specs):
         d = _fsdp_dim(spec)
         dz = z1_dims[i]
@@ -301,14 +439,18 @@ def declared_bucket_collectives(specs, out_specs=None) -> List[str]:
             if d is None:
                 ops.append("psum@fsdp")
         else:
-            ops.append("psum@data")
+            ops.append("psum@" + "+".join(a for a in reduce_axes
+                                          if a != "fsdp"))
     return ops
 
 
-def _exchange_bucket(leaves, specs, out_specs=None, compress=None):
+def _exchange_bucket(leaves, specs, out_specs=None, compress=None,
+                     reduce_axes=BATCH_AXES):
     """One bucket's gradient exchange: replicated leaves ride a single
-    tuple-psum over both batch axes (one collective issue); fsdp-sharded
-    leaves psum over ``data`` and psum_scatter over ``fsdp`` on their
+    tuple-psum over the bucket's reduce-axis set (``reduce_axes`` — the
+    batch axes, plus the shaping axes the leaves replicate over on
+    pipeline/expert layouts; one collective issue); fsdp-sharded leaves
+    psum over the remaining axes and psum_scatter over ``fsdp`` on their
     sharded dim (the ZeRO reduce-scatter), landing exactly in the leaf's
     training-state layout. Returns leaves in input order.
 
@@ -326,6 +468,7 @@ def _exchange_bucket(leaves, specs, out_specs=None, compress=None):
     stays bit-identical under compression."""
     if out_specs is None:
         out_specs = specs
+    reduce_axes = tuple(reduce_axes)
     in_dt = leaves[0].dtype if leaves else jnp.float32
     if compress is not None:
         cdt = COMPRESS_DTYPES[compress]
@@ -335,9 +478,10 @@ def _exchange_bucket(leaves, specs, out_specs=None, compress=None):
                if _fsdp_dim(s) is None and z1_dims[i] is None]
     out: List[Any] = [None] * len(leaves)
     if rep_idx:
-        summed = lax.psum(tuple(leaves[i] for i in rep_idx), BATCH_AXES)
+        summed = lax.psum(tuple(leaves[i] for i in rep_idx), reduce_axes)
         for i, v in zip(rep_idx, summed):
             out[i] = v
+    rem_axes = tuple(a for a in reduce_axes if a != "fsdp")
     for i, (leaf, spec) in enumerate(zip(leaves, specs)):
         d = _fsdp_dim(spec)
         dz = z1_dims[i]
@@ -356,7 +500,7 @@ def _exchange_bucket(leaves, specs, out_specs=None, compress=None):
             if d is None:
                 leaf = lax.psum(leaf, "fsdp")
         else:
-            leaf = lax.psum(leaf, "data")
+            leaf = lax.psum(leaf, rem_axes)
         out[i] = leaf
     if compress is not None:
         # f32 re-materialization: everything downstream of the exchange
@@ -373,11 +517,14 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                        fused_xent: str = "off",
                        aux_loss_weight: float = 0.01,
                        zero1_min_size: Optional[int] = None,
-                       precision=None) -> Callable:
+                       precision=None,
+                       grad_accum_steps: int = 1,
+                       augment_fn: Optional[Callable] = None,
+                       augment_seed: int = 0) -> Callable:
     """Drop-in replacement for ``jax.value_and_grad(loss_fn, has_aux=True)``
     in train/loop.make_train_step's single step:
 
-        grad_fn(params, batch_stats, images, labels, apply_fn)
+        grad_fn(params, batch_stats, images, labels, apply_fn, step=0)
             -> ((loss, (ce, logits, new_batch_stats)), grads)
 
     with the gradient exchange bucketed as described in the module
@@ -399,11 +546,29 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
     policy input cast the jit path's loss_fn applies
     (train/loop.make_train_step) — the shard_map body must mirror it or
     the overlap step would compute a different program than the step it
-    replaces."""
-    from .mesh import batch_shard_count, shard_map_compat
+    replaces.
+
+    ``grad_accum_steps`` > 1 runs the microbatch scan INSIDE the body
+    (module docstring): local f32 accumulation, the final microbatch
+    peeled out of the scan, ONE bucketed exchange after it — per-step
+    wire traffic is 1× the gradient bytes instead of accum×, and the
+    exchange overlaps the last microbatch's backprop. ``augment_fn`` /
+    ``augment_seed`` mirror make_train_step's per-microbatch prep with
+    per-(shard, step, microbatch) keys — draws stay i.i.d. per example
+    across shards, and both bucketing plans use the same keys so
+    bucketing stays a pure scheduling change; ``step`` feeds the RNG."""
+    from .mesh import batch_shard_count, manual_axes, shard_map_compat
     from ..train.loop import make_ce_fn
     from ..train.optimizers import loss_weight_decay
     n_shards = batch_shard_count(mesh)
+    auto = overlap_auto_axes(mesh)
+    manual = frozenset(a for a in mesh.axis_names if a not in auto)
+    shaping = overlap_shaping_axes(mesh)
+    loss_axes = BATCH_AXES + shaping
+    r_scale = int(np.prod([mesh.shape[a] for a in shaping], dtype=np.int64)) \
+        if shaping else 1
+    n_total = n_shards * r_scale
+    accum = max(1, grad_accum_steps)
     # the SAME mode/smoothing resolution the jit path uses, unreduced: the
     # caller's shard_map body is already per-shard, so the Pallas kernel
     # (fused_xent on/interpret) runs directly on the local (b/n, C) tile
@@ -411,15 +576,24 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                                 per_example=True)
     batch_spec = P(BATCH_AXES)
 
-    def grad_fn(params, batch_stats, images, labels, apply_fn):
+    def grad_fn(params, batch_stats, images, labels, apply_fn, step=0):
         n_global = images.shape[0]
         pspecs = _param_specs(params, mesh)
+        if auto:
+            # shard_map specs may only name MANUAL axes — auto ("tensor")
+            # references are stripped; the auto-axis sharding rides GSPMD
+            # propagation through the body instead
+            mspecs = jax.tree_util.tree_map(
+                _strip_axes(auto), pspecs,
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            mspecs = pspecs
         if zero1_min_size is not None:
             from .sharding import zero1_grad_specs
             gout_specs = zero1_grad_specs(params, mesh,
                                           min_size=zero1_min_size)
         else:
-            gout_specs = pspecs
+            gout_specs = mspecs
         bs_specs = jax.tree_util.tree_map(lambda _: P(), batch_stats)
 
         def body(params_l, bstats, images_l, labels_l):
@@ -431,46 +605,121 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                     return leaf
                 return lax.all_gather(leaf, "fsdp", axis=d, tiled=True)
 
-            pfull = jax.tree_util.tree_map(gather, params_l, pspecs)
+            pfull = jax.tree_util.tree_map(gather, params_l, mspecs)
 
-            def local_loss(pf, bs):
+            def local_loss(pf, bs, images_mb, labels_mb, mb_global):
                 variables = {"params": pf, "batch_stats": bs}
-                imgs = images_l if precision is None \
-                    else precision.cast_compute(images_l)
+                imgs = images_mb if precision is None \
+                    else precision.cast_compute(images_mb)
                 logits, mutated = apply_fn(variables, imgs, train=True,
                                            mutable=["batch_stats",
                                                     "losses"])
                 # local CONTRIBUTION to the global mean loss: sum of this
-                # shard's per-example CE over the GLOBAL batch size; the
-                # replicated terms (decay, aux) are pre-divided by the
-                # shard count so the psum below reconstructs them once —
-                # grads then exchange as a plain sum, no post-scaling
-                ce_part = per_example_ce(logits, labels_l).sum() / n_global
+                # shard's per-example CE over the GLOBAL (micro)batch
+                # size; replicated terms (decay, aux) are pre-divided by
+                # the total manual peer count, and on shaped meshes the
+                # CE part by the redundancy factor R, so the psum over
+                # ``loss_axes`` reconstructs each exactly once — grads
+                # then exchange as a plain sum, no post-scaling (the
+                # module docstring's replicated-leaf calculus)
+                ce_part = per_example_ce(logits, labels_mb).sum() \
+                    / mb_global
+                if r_scale != 1:
+                    ce_part = ce_part / r_scale
                 loss_part = ce_part
                 if decay_in_loss:
                     loss_part = loss_part + loss_weight_decay(
-                        pf, weight_decay, decay_all_params) / n_shards
+                        pf, weight_decay, decay_all_params) / n_total
                 aux = jax.tree_util.tree_leaves(mutated.get("losses", {}))
                 if aux:
                     loss_part = loss_part + aux_loss_weight * sum(
-                        jnp.sum(a) for a in aux) / n_shards
+                        jnp.sum(a) for a in aux) / n_total
                 return loss_part, (ce_part, logits,
                                    mutated["batch_stats"])
 
-            (loss_part, (ce_part, logits, new_bs)), grads = \
-                jax.value_and_grad(local_loss, has_aux=True)(pfull, bstats)
+            def micro_grad(bs, images_mb, labels_mb, mb_global):
+                return jax.value_and_grad(
+                    local_loss, has_aux=True)(pfull, bs, images_mb,
+                                              labels_mb, mb_global)
 
-            # bucketed exchange, reverse parameter order; buckets chained
-            # through optimization_barrier so they issue in order and the
+            if accum <= 1:
+                (loss_part, (ce_part, logits, new_bs)), grads = \
+                    micro_grad(bstats, images_l, labels_l, n_global)
+            else:
+                # the in-envelope accumulation scan: local f32 grads
+                # accumulate across the first accum-1 microbatches inside
+                # lax.scan; the LAST microbatch runs peeled so its
+                # backward is still in flight when the reverse-order
+                # buckets start issuing — the exchange hides behind it
+                local_b = images_l.shape[0]
+                mb = local_b // accum
+                mb_global = n_global // accum
+                im = images_l.reshape((accum, mb) + images_l.shape[1:])
+                lb = labels_l.reshape((accum, mb) + labels_l.shape[1:])
+
+                def prep_mb(images_mb, midx):
+                    if augment_fn is None:
+                        return images_mb
+                    # fold in this shard's batch coordinate: the body is
+                    # per-shard, so one shared key would give example i
+                    # on EVERY shard identical crop/flip draws — an N×
+                    # cut in augmentation diversity vs the jit path's
+                    # global-batch draws. Per-(shard, step, microbatch)
+                    # keys keep draws i.i.d. per example; bucketing stays
+                    # a pure scheduling change (same keys both plans).
+                    shard = lax.axis_index("data") * mesh.shape["fsdp"] \
+                        + lax.axis_index("fsdp")
+                    rng = jax.random.fold_in(
+                        jax.random.fold_in(
+                            jax.random.fold_in(
+                                jax.random.PRNGKey(augment_seed), step),
+                            midx), shard)
+                    return augment_fn(images_mb, rng)
+
+                def scan_body(carry, xs):
+                    grads_acc, bs = carry
+                    images_mb, labels_mb, midx = xs
+                    (lp, (cp, lg, nbs)), g = micro_grad(
+                        bs, prep_mb(images_mb, midx), labels_mb,
+                        mb_global)
+                    grads_acc = jax.tree_util.tree_map(jnp.add,
+                                                       grads_acc, g)
+                    return (grads_acc, nbs), (lp, cp, lg)
+
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(np.shape(p), jnp.float32), pfull)
+                (grads_acc, bs_carry), (lps, cps, lgs) = jax.lax.scan(
+                    scan_body, (zero_grads, bstats),
+                    (im[:-1], lb[:-1], jnp.arange(accum - 1)))
+                (lp_last, (cp_last, lg_last, new_bs)), g_last = \
+                    micro_grad(bs_carry, prep_mb(im[-1], accum - 1),
+                               lb[-1], mb_global)
+                grads = jax.tree_util.tree_map(
+                    lambda a, b: (a + b) / accum, grads_acc, g_last)
+                # metrics mirror the jit accumulation path: loss/ce are
+                # the MEAN over microbatches of the per-microbatch global
+                # values; logits reassemble in batch order
+                loss_part = (jnp.sum(lps) + lp_last) / accum
+                ce_part = (jnp.sum(cps) + cp_last) / accum
+                logits = jnp.concatenate(
+                    [lgs.reshape((-1,) + lgs.shape[2:]), lg_last], axis=0)
+
+            # bucketed exchange, reverse parameter order, grouped by
+            # reduce-axis set; buckets chained through
+            # optimization_barrier so they issue in order and the
             # all-reduce combiner can't re-merge them (see module
             # docstring)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
-            spec_leaves = treedef.flatten_up_to(pspecs)
+            spec_leaves = treedef.flatten_up_to(mspecs)
             z1_leaves = treedef.flatten_up_to(gout_specs)
+            reduce_sets = [leaf_reduce_axes(s, shaping)
+                           for s in spec_leaves]
             leaf_bytes = [int(np.prod(np.shape(g)) *
                               np.dtype(g.dtype).itemsize) for g in leaves]
-            buckets = plan_buckets(leaf_bytes, plan.bucket_bytes)
-            bucket_sizes = [sum(leaf_bytes[i] for i in b) for b in buckets]
+            buckets = plan_buckets_grouped(leaf_bytes, reduce_sets,
+                                           plan.bucket_bytes)
+            bucket_sizes = [sum(leaf_bytes[i] for i in b)
+                            for _, b in buckets]
             # the bucket PLAN is computed from the uncompressed leaf
             # bytes either way — compression narrows the wire format on
             # the same plan, so A/B rows compare like for like
@@ -481,17 +730,20 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
             else:
                 wire_sizes = bucket_sizes
             declared = [declared_bucket_collectives(
-                [spec_leaves[i] for i in b], [z1_leaves[i] for i in b])
-                for b in buckets]
+                [spec_leaves[i] for i in b], [z1_leaves[i] for i in b],
+                reduce_axes=axes)
+                for axes, b in buckets]
             overlap_stats.record(plan.bucket_bytes, bucket_sizes,
-                                 [len(b) for b in buckets],
+                                 [len(b) for _, b in buckets],
                                  sum(leaf_bytes), len(leaves),
                                  compress=plan.compress,
                                  wire_bytes=wire_sizes,
-                                 declared=declared)
+                                 declared=declared,
+                                 reduce_axes=[axes for axes, _ in buckets],
+                                 accum_steps=accum)
             out_leaves: List[Any] = [None] * len(leaves)
             anchor = None
-            for bi, (b, nbytes, wbytes) in enumerate(
+            for bi, ((axes, b), nbytes, wbytes) in enumerate(
                     zip(buckets, bucket_sizes, wire_sizes)):
                 # flight recorder: one (trace-time) span per planned
                 # bucket — the plan is visible in trace.json without
@@ -505,24 +757,66 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                     exchanged = _exchange_bucket(
                         vals, [spec_leaves[i] for i in b],
                         out_specs=[z1_leaves[i] for i in b],
-                        compress=plan.compress)
+                        compress=plan.compress, reduce_axes=axes)
                     anchor = exchanged[0]
                     for i, v in zip(b, exchanged):
                         out_leaves[i] = v
-            grads = jax.tree_util.tree_unflatten(treedef, out_leaves)
-            loss = lax.psum(loss_part, BATCH_AXES)
-            ce = lax.psum(ce_part, BATCH_AXES)
-            return loss, ce, logits, new_bs, grads
+            if auto:
+                # pin the exchanged grads' auto-axis (tensor) placement
+                # so the optimizer update consumes them without a reshard
+                out_leaves = [
+                    _constrain_auto(v, s, mesh, auto)
+                    for v, s in zip(out_leaves,
+                                    treedef.flatten_up_to(pspecs))]
+            grads_out = jax.tree_util.tree_unflatten(treedef, out_leaves)
+            loss = lax.psum(loss_part, loss_axes)
+            ce = lax.psum(ce_part, loss_axes)
+            return loss, ce, logits, new_bs, grads_out
+
+        def ctx_body(params_l, bstats, images_l, labels_l):
+            # the manual-axes context (parallel/mesh.py) tells model code
+            # traced inside the body that these axes are already mapped:
+            # constraints drop them, the PipelinedEncoder runs inline
+            with manual_axes(manual):
+                return body(params_l, bstats, images_l, labels_l)
 
         sharded = shard_map_compat(
-            body, mesh,
-            in_specs=(pspecs, bs_specs, batch_spec, batch_spec),
-            out_specs=(P(), P(), batch_spec, bs_specs, gout_specs))
+            ctx_body, mesh,
+            in_specs=(mspecs, bs_specs, batch_spec, batch_spec),
+            out_specs=(P(), P(), batch_spec, bs_specs, gout_specs),
+            auto=auto)
         loss, ce, logits, new_bs, grads = sharded(params, batch_stats,
                                                   images, labels)
         return (loss, (ce, logits, new_bs)), grads
 
+    # the accumulation contract the step builder validates
+    # (train/loop.make_train_step): a grad fn built for a different
+    # accum factor than the step's would silently skip accumulation
+    grad_fn.grad_accum_steps = accum
     return grad_fn
+
+
+def _strip_axes(drop: frozenset):
+    """PartitionSpec transformer removing ``drop``-axis references (the
+    shard_map-facing spec: manual specs may not name auto axes)."""
+    from .mesh import filter_spec_axes
+
+    def strip(spec: P) -> P:
+        return filter_spec_axes(spec, lambda n: n not in drop)
+    return strip
+
+
+def _constrain_auto(leaf, spec: P, mesh: Mesh, auto: frozenset):
+    """with_sharding_constraint on the AUTO axes of ``spec`` only — how
+    the exchanged gradients keep their tensor placement inside the
+    partially-manual body (constraints naming manual axes are illegal
+    there)."""
+    from .mesh import filter_spec_axes
+    aspec = filter_spec_axes(spec, lambda n: n in auto)
+    if not any(e is not None for e in aspec):
+        return leaf
+    from jax.sharding import NamedSharding
+    return lax.with_sharding_constraint(leaf, NamedSharding(mesh, aspec))
 
 
 def make_bucketed_gather(plan: OverlapPlan, mesh: Mesh,
@@ -655,6 +949,13 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
         else np.dtype(COMPRESS_DTYPES[compress])
     axes = [a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1] \
         or list(BATCH_AXES)
+    # layout-aware plans carry one reduce-axis set per bucket (the
+    # grouped planner) — each bucket's probe psums over ITS set, so the
+    # timed collective matches what the step actually issues
+    bucket_axes = [tuple(s.split("+"))
+                   for s in snap.get("bucket_reduce_axes",
+                                     ["+".join(BATCH_AXES)]
+                                     * len(snap["bucket_bytes"]))]
     replicated = NamedSharding(mesh, P())
 
     # -- phase 1: LOCAL prep (deterministic; no collective issued) -------
@@ -668,13 +969,14 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
         agree_c = jax.jit(shard_map_compat(
             _agree, mesh, in_specs=P(), out_specs=P()))
 
-        def _psum(x):
-            return lax.psum(x, tuple(axes))
-
-        for bi, (nbytes, wbytes, leaves) in enumerate(zip(
+        for bi, (nbytes, wbytes, leaves, baxes) in enumerate(zip(
                 snap["bucket_bytes"], snap["bucket_wire_bytes"],
-                snap["bucket_leaves"])):
+                snap["bucket_leaves"], bucket_axes)):
             elems = max(1, int(wbytes) // wire_dtype.itemsize)
+
+            def _psum(x, _axes=baxes):
+                return lax.psum(x, _axes)
+
             # AOT-compile BOTH programs now — jax.jit alone is lazy and
             # would push compilation past the vote into phase 3
             fn = jax.jit(shard_map_compat(
@@ -684,7 +986,7 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
             fill = jax.jit(lambda e=elems: jnp.zeros((e,), wire_dtype),
                            out_shardings=replicated).lower().compile()
             programs.append((bi, int(nbytes), int(wbytes), int(leaves),
-                             fn, fill))
+                             baxes, fn, fill))
     except Exception:  # pragma: no cover - prep is best effort
         log.exception("comm-plan probe prep failed; voting to abandon")
         ok = 0.0
@@ -710,7 +1012,7 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
     buckets = []
     total = 0.0
     try:
-        for bi, nbytes, wbytes, leaves, fn, fill in programs:
+        for bi, nbytes, wbytes, leaves, baxes, fn, fill in programs:
             x = fill()
             jax.block_until_ready(fn(x))  # compile + warm
             best = None
@@ -728,6 +1030,7 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
                 "bytes": nbytes,
                 "wire_bytes": wbytes,
                 "leaves": leaves,
+                "axes": "+".join(baxes),
                 "probe_secs": round(best, 6),
                 "wire_bytes_per_sec": round(wbytes / best, 1)
                 if best > 0 else 0.0,

@@ -29,7 +29,10 @@ resolution point for the three low-precision knobs (docs/precision.md):
   * ``serve.variants`` — reduced-precision SERVING variants
     (serve/compile_cache.py buckets become (batch, variant)): a ``bf16``
     variant serves from a bf16-cast weight copy through a bf16-compute
-    predict step. Resolved by :func:`resolve_serve_variants`.
+    predict step; an ``int8`` variant is WEIGHT-ONLY — kernels quantize
+    to int8 with per-output-channel f32 scales (¼ the weight HBM) and
+    dequantize into an f32 forward at apply time. Resolved by
+    :func:`resolve_serve_variants`.
 
 Checkpoints are policy-agnostic by construction: the masters are f32, so
 save/restore and the serving hot swap never see a cast leaf —
@@ -52,9 +55,57 @@ import jax.numpy as jnp
 #: dtypes a policy / compressed exchange / serving variant may name
 POLICY_DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
 
-#: serving-variant names → compute/weight dtype (``f32`` is the
-#: policy-native full-precision variant every server carries implicitly)
-SERVE_VARIANT_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16}
+#: serving-variant names → COMPUTE dtype (``f32`` is the policy-native
+#: full-precision variant every server carries implicitly). ``int8`` is
+#: WEIGHT-ONLY: kernels live in HBM as int8 with a per-channel f32 scale
+#: (make_variant_cast) and dequantize into the f32 forward at apply time
+#: — ¼ the weight bytes per replica, full-precision arithmetic.
+SERVE_VARIANT_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                        "int8": jnp.float32}
+
+#: variants whose CAST changes the weight REPRESENTATION (not just the
+#: dtype): their predict step must dequantize before model apply
+#: (train/loop.Trainer.make_variant_predict_step)
+WEIGHT_ONLY_VARIANTS = frozenset({"int8"})
+
+#: per-channel symmetric int8 range (the scale denominator); -128 is
+#: excluded so the quantizer stays symmetric around zero
+INT8_QMAX = 127.0
+
+#: params below this many dims stay f32 under the int8 variant: biases,
+#: LayerNorm/BN scales are tiny (no memory win) and precision-critical
+INT8_MIN_NDIM = 2
+
+
+def quantize_leaf_int8(w):
+    """One float leaf → ``{"int8_q", "int8_scale"}``: symmetric
+    per-OUTPUT-CHANNEL (last dim) scales, values rounded into [-127,127].
+    Works on live arrays and under ``jax.eval_shape`` (pure jnp)."""
+    wf = jnp.asarray(w).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=tuple(range(wf.ndim - 1)),
+                   keepdims=False)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -INT8_QMAX, INT8_QMAX)
+    return {"int8_q": q.astype(jnp.int8),
+            "int8_scale": scale.astype(jnp.float32)}
+
+
+def _is_quantized_leaf(x) -> bool:
+    return isinstance(x, dict) and set(x) == {"int8_q", "int8_scale"}
+
+
+def dequantize_params(params):
+    """Inverse of the int8 cast: every ``{"int8_q", "int8_scale"}``
+    marker dict becomes ``q * scale`` (f32); untouched leaves pass
+    through. XLA fuses the dequant into the consuming matmul, so the
+    weights stay int8 at rest and widen on the fly."""
+    def deq(x):
+        if _is_quantized_leaf(x):
+            return x["int8_q"].astype(jnp.float32) * x["int8_scale"]
+        return x
+
+    return jax.tree_util.tree_map(deq, params,
+                                  is_leaf=_is_quantized_leaf)
 
 
 @dataclass(frozen=True)
@@ -137,7 +188,28 @@ def make_variant_cast(variant: str):
     thread — serve/server.py builds variants at startup and at swap
     boundaries, both single-dispatch-thread safe) AND under
     ``jax.eval_shape`` (serve/compile_cache.py derives each variant's
-    abstract state the same way, so the two cannot drift)."""
+    abstract state the same way, so the two cannot drift).
+
+    ``int8`` (weight-only, docs/precision.md): every float param leaf
+    with ≥ ``INT8_MIN_NDIM`` dims becomes a ``{"int8_q", "int8_scale"}``
+    pair — symmetric per-output-channel quantization
+    (:func:`quantize_leaf_int8`); biases/norm scales and the
+    ``batch_stats`` running moments stay f32 (tiny, precision-critical).
+    The matching predict step dequantizes at apply time
+    (:func:`dequantize_params` via Trainer.make_variant_predict_step)."""
+    if variant in WEIGHT_ONLY_VARIANTS:
+        def quant_leaf(x):
+            arr = jnp.asarray(x)
+            if jnp.issubdtype(arr.dtype, jnp.floating) \
+                    and arr.ndim >= INT8_MIN_NDIM:
+                return quantize_leaf_int8(arr)
+            return x
+
+        def quant(state):
+            return state.replace(
+                params=jax.tree_util.tree_map(quant_leaf, state.params))
+
+        return quant
     dt = SERVE_VARIANT_DTYPES[variant]
     if dt == jnp.float32:
         return lambda state: state

@@ -95,10 +95,17 @@ class ServeCompileCache:
         # abstract cast exactly as the server casts the live weights
         self._predicts = {"f32": trainer._predict_step}
         self._abstracts = {"f32": self._state_abstract}
+        self._st_shs = {"f32": self._st_sh}
         for name, fn in (variant_predicts or {}).items():
             self._predicts[name] = fn
             self._abstracts[name] = jax.eval_shape(
                 make_variant_cast(name), self._state_abstract)
+            # weight-only variants (int8) restructure the param tree
+            # (quantized marker dicts), so each variant resolves its OWN
+            # sharding tree over its cast abstract state — the rule table
+            # is path-based and handles the extra q/scale leaves
+            self._st_shs[name] = state_shardings(self._abstracts[name],
+                                                 trainer.mesh)
         self._compiled: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
         self.warm_secs = 0.0
@@ -117,7 +124,8 @@ class ServeCompileCache:
         batch_abstract = {"images": jax.ShapeDtypeStruct(
             (bucket,) + tuple(image_shape), np.dtype(dtype))}
         jitted = jax.jit(self._predicts[variant],
-                         in_shardings=(self._st_sh, {"images": self._b_sh}))
+                         in_shardings=(self._st_shs[variant],
+                                       {"images": self._b_sh}))
         return jitted.lower(self._abstracts[variant],
                             batch_abstract).compile()
 

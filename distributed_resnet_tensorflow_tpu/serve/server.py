@@ -94,8 +94,7 @@ class InferenceServer:
         # AOT programs; the FIRST is the default a variant-less request
         # gets. The f32 masters themselves live on the trainer state —
         # variants are rebuilt from them at every (startup/hot) swap.
-        from ..parallel.precision import (SERVE_VARIANT_DTYPES,
-                                          make_variant_cast,
+        from ..parallel.precision import (make_variant_cast,
                                           resolve_serve_variants)
         self.variants = resolve_serve_variants(cfg)
         self._variant_casts = {v: make_variant_cast(v)
@@ -115,8 +114,7 @@ class InferenceServer:
         self.buckets = bucket_sizes(max_batch,
                                     self.trainer.eval_pad_multiple())
         variant_predicts = {
-            v: self.trainer.make_variant_predict_step(
-                SERVE_VARIANT_DTYPES[v])
+            v: self.trainer.make_variant_predict_step(v)
             for v in self.variants if v != "f32"}
         if "f32" in self.variants and self.trainer.precision_active:
             # the f32 variant is the FULL-PRECISION oracle even when the
@@ -124,8 +122,7 @@ class InferenceServer:
             # trainer's own predict step computes in the policy dtype,
             # so the f32 variant needs its own f32-compute program
             variant_predicts["f32"] = \
-                self.trainer.make_variant_predict_step(
-                    SERVE_VARIANT_DTYPES["f32"])
+                self.trainer.make_variant_predict_step("f32")
         self.cache = ServeCompileCache(self.trainer,
                                        variant_predicts=variant_predicts)
         self.latency = LatencyStats()

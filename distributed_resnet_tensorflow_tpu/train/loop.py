@@ -131,8 +131,12 @@ def make_train_step(schedule: Callable, weight_decay: float,
     ``value_and_grad_fn`` replaces ``jax.value_and_grad(loss_fn)`` with a
     custom gradient strategy sharing its exact signature/aux contract —
     the bucketed-overlap exchange (parallel/overlap.make_bucketed_grad)
-    plugs in here. Incompatible with grad_accum_steps > 1 (the
-    accumulation scan exchanges once per accumulated batch).
+    plugs in here. With grad_accum_steps > 1 it OWNS the accumulation:
+    the microbatch scan runs inside its shard_map body (local f32
+    accumulation, one bucketed exchange after the final microbatch —
+    per-step wire traffic 1× instead of accum×), so the outer
+    ``accum_step`` below is bypassed and per-microbatch augmentation
+    (``prep``'s midx draws) moves into the body with it.
 
     ``apply_gradients_fn(state, grads) -> state`` replaces the default
     ``state.apply_gradients(grads)`` — the ZeRO-1 sharded weight update
@@ -147,10 +151,19 @@ def make_train_step(schedule: Callable, weight_decay: float,
     gradients/optimizer update run on the f32 masters."""
     if ce_fn is None:
         ce_fn = make_ce_fn(label_smoothing)
-    if value_and_grad_fn is not None and grad_accum_steps > 1:
-        raise ValueError(
-            "a custom value_and_grad_fn (comm.overlap) is incompatible "
-            "with train.grad_accum_steps > 1")
+    if value_and_grad_fn is not None:
+        # the overlap grad fn owns the accumulation scan — its built-in
+        # factor must match this step's, or the 'accumulated' run would
+        # silently train one giant microbatch (make_bucketed_grad stamps
+        # the attribute; a custom fn without one is assumed accum-free)
+        vag_accum = getattr(value_and_grad_fn, "grad_accum_steps", 1)
+        if vag_accum != max(1, grad_accum_steps):
+            raise ValueError(
+                f"value_and_grad_fn was built for grad_accum_steps="
+                f"{vag_accum} but the step is configured with "
+                f"{grad_accum_steps} — build the overlap grad fn with "
+                "the step's accumulation factor "
+                "(parallel/overlap.make_bucketed_grad)")
     if apply_gradients_fn is None:
         apply_gradients_fn = lambda state, grads: \
             state.apply_gradients(grads)  # noqa: E731
@@ -188,11 +201,19 @@ def make_train_step(schedule: Callable, weight_decay: float,
         return loss, (ce, logits, mutated["batch_stats"])
 
     def single_step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
-        images, labels = prep(batch["images"], state.step), batch["labels"]
-        grad_fn = value_and_grad_fn if value_and_grad_fn is not None \
-            else jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (ce, logits, new_bs)), grads = grad_fn(
-            state.params, state.batch_stats, images, labels, state.apply_fn)
+        images, labels = batch["images"], batch["labels"]
+        if value_and_grad_fn is None or grad_accum_steps <= 1:
+            # the overlap body preps per MICROBATCH itself when it owns
+            # the accumulation scan (distinct midx draws, like accum_step)
+            images = prep(images, state.step)
+        if value_and_grad_fn is not None:
+            (loss, (ce, logits, new_bs)), grads = value_and_grad_fn(
+                state.params, state.batch_stats, images, labels,
+                state.apply_fn, step=state.step)
+        else:
+            (loss, (ce, logits, new_bs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, state.batch_stats,
+                                       images, labels, state.apply_fn)
         new_state = apply_gradients_fn(state, grads).replace(
             batch_stats=new_bs)
         precision = jnp.mean(
@@ -204,7 +225,9 @@ def make_train_step(schedule: Callable, weight_decay: float,
         }
         return new_state, metrics
 
-    if grad_accum_steps <= 1:
+    if grad_accum_steps <= 1 or value_and_grad_fn is not None:
+        # the overlap exchange owns the accumulation scan (one bucketed
+        # exchange per optimizer step, inside its shard_map body)
         return single_step
 
     def accum_step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, Any]]:
@@ -633,7 +656,9 @@ class Trainer:
                 aux_loss_weight=cfg.model.moe_aux_weight,
                 zero1_min_size=self._zero1_min_size()
                 if self._zero1 else None,
-                precision=self._precision)
+                precision=self._precision,
+                grad_accum_steps=cfg.train.grad_accum_steps,
+                augment_fn=aug_fn, augment_seed=cfg.train.seed)
         return make_train_step(
             self.schedule, cfg.optimizer.weight_decay,
             cfg.optimizer.label_smoothing,
@@ -675,21 +700,38 @@ class Trainer:
         return self._overlap is not None and \
             self._overlap.compress is not None
 
-    def make_variant_predict_step(self, compute_dtype):
+    def make_variant_predict_step(self, variant: str):
         """The serving VARIANT forward (serve/compile_cache.py buckets
         are (batch, variant)): a predict step whose model computes in
-        ``compute_dtype``, sharing every other model-resolution choice
-        with this Trainer (BN axis/groups, remat, prep contract) so the
-        variant differs only in precision. The caller supplies the
-        matching (cast) TrainState — the step uses its own apply, not
-        ``state.apply_fn``."""
+        the variant's compute dtype
+        (``parallel.precision.SERVE_VARIANT_DTYPES``), sharing every
+        other model-resolution choice with this Trainer (BN axis/groups,
+        remat, prep contract) so the variant differs only in precision.
+        The caller supplies the matching (cast) TrainState — the step
+        uses its own apply, not ``state.apply_fn``.
+
+        Weight-only variants ("int8"): the cast state carries quantized
+        ``{"int8_q", "int8_scale"}`` kernels, so the apply first
+        dequantizes them (``parallel.precision.dequantize_params`` —
+        fused into the consuming ops by XLA) and the model computes f32
+        over int8-at-rest weights."""
         from ..models import create_model
+        from ..parallel.precision import (SERVE_VARIANT_DTYPES,
+                                          WEIGHT_ONLY_VARIANTS,
+                                          dequantize_params)
         model = create_model(self.cfg.model, self.cfg.data.dataset,
                              axis_name=self._bn_axis_name,
                              remat=self.cfg.train.remat,
                              bn_groups=self._bn_groups, mesh=self.mesh,
-                             compute_dtype=compute_dtype)
-        return make_predict_step(self._eval_prep, apply_fn=model.apply)
+                             compute_dtype=SERVE_VARIANT_DTYPES[variant])
+        apply_fn = model.apply
+        if variant in WEIGHT_ONLY_VARIANTS:
+            def apply_fn(variables, *args, _apply=model.apply, **kw):
+                variables = dict(variables)
+                variables["params"] = dequantize_params(
+                    variables["params"])
+                return _apply(variables, *args, **kw)
+        return make_predict_step(self._eval_prep, apply_fn=apply_fn)
 
     # -- state ------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None) -> TrainState:

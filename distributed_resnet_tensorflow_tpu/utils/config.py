@@ -545,10 +545,13 @@ class ServeConfig:
     # cache buckets become (batch, variant) and every listed variant gets
     # its own weight copy + AOT programs — "bf16" serves from bf16-cast
     # weights through a bf16-compute predict step (about half the weight
-    # HBM and MXU-rate matmuls per replica). The FIRST entry is the
-    # default a variant-less request is served from; hot swaps rebuild
-    # every variant from the new f32 masters. Checkpoints are untouched
-    # (serving casts at apply time, never at rest).
+    # HBM and MXU-rate matmuls per replica); "int8" is WEIGHT-ONLY
+    # quantization (per-output-channel scales, ¼ the kernel HBM,
+    # f32 compute over dequantized weights — the parity bound vs the f32
+    # variant is pinned in tests/test_precision.py). The FIRST entry is
+    # the default a variant-less request is served from; hot swaps
+    # rebuild every variant from the new f32 masters. Checkpoints are
+    # untouched (serving quantizes/casts at swap time, never at rest).
     variants: Tuple[str, ...] = ("f32",)
 
 
@@ -809,6 +812,26 @@ def _vit_large_224() -> ExperimentConfig:
     return cfg
 
 
+def _vit_moe() -> ExperimentConfig:
+    """Switch-MoE ViT — the expert-parallel member of the preset zoo.
+    Sized so every transformer layout elaborates on the virtual 8-device
+    gate mesh (dp / dp_fsdp / dp_pp / dp_tp / dp_pp_ep: depth 8 % 2
+    stages, heads 4 % tensor 2, experts 4 % expert 2, bs 64 % shards ×
+    microbatches), giving the MoE/pipeline overlap + collective-schedule
+    families a shipped config instead of test-only ad-hoc ones."""
+    cfg = ExperimentConfig()
+    cfg.model = ModelConfig(
+        name="vit", num_classes=10, vit_patch_size=4, vit_dim=128,
+        vit_depth=8, vit_heads=4, vit_num_experts=4,
+        attention_impl="dense")
+    cfg.data = DataConfig(dataset="synthetic", image_size=32)
+    cfg.optimizer = OptimizerConfig(
+        name="adamw", learning_rate=3e-4, weight_decay=0.02,
+        schedule="cosine", warmup_steps=1000, total_steps=50000)
+    cfg.train = TrainConfig(batch_size=64, train_steps=50000)
+    return cfg
+
+
 def _cifar10_smoke() -> ExperimentConfig:
     """Local smoke test analog of reference scripts/submit_mac_dist.sh
     (1ps+2wk, bs=10, 100 steps on CPU — SURVEY.md §4.1)."""
@@ -831,6 +854,7 @@ PRESETS = {
     "imagenet_resnet50_lamb4k": _imagenet_resnet50_lamb4k,
     "vit_long_context": _vit_long_context,
     "vit_large_224": _vit_large_224,
+    "vit_moe": _vit_moe,
     "smoke": _cifar10_smoke,
 }
 

@@ -404,7 +404,16 @@ EVENT_SCHEMAS = {
                                  "(= bucket_bytes when compress is off; "
                                  "halved under bf16/fp16 on the SAME "
                                  "bucket plan)",
-            "wire_bytes": "total wire bytes per step exchange",
+            "wire_bytes": "total wire bytes per step exchange (ONE "
+                          "exchange per optimizer step — under "
+                          "accumulation this is 1/accum of what a "
+                          "per-microbatch exchange would move)",
+            "bucket_reduce_axes": "per-bucket reduce-axis set "
+                                  "('data+fsdp', '…+pipeline+expert' on "
+                                  "shaped layouts) — one set per bucket "
+                                  "by construction (the grouped planner)",
+            "accum_steps": "train.grad_accum_steps the exchange "
+                           "accumulates over inside the body (1 = none)",
         },
     },
     "comm_timing": {
@@ -414,10 +423,11 @@ EVENT_SCHEMAS = {
         "fields": {
             "step": "step at export time",
             "buckets": "per-bucket measured attribution, issue order: "
-                       "{bucket, bytes, wire_bytes, leaves, probe_secs, "
-                       "wire_bytes_per_sec} — probe_secs is the bucket's "
-                       "collective timed STANDALONE on the live mesh "
-                       "(wire dtype/bytes), not its in-step exposed time",
+                       "{bucket, bytes, wire_bytes, leaves, axes, "
+                       "probe_secs, wire_bytes_per_sec} — probe_secs is "
+                       "the bucket's collective timed STANDALONE on the "
+                       "live mesh (wire dtype/bytes, the bucket's own "
+                       "reduce-axis set), not its in-step exposed time",
             "comm_secs_total": "sum of the per-bucket standalone times — "
                                "what the exchange would cost fully "
                                "exposed",

@@ -329,8 +329,9 @@ def test_schedule_plan_mismatch_is_a_finding(devices, monkeypatch):
     monkeypatch.setitem(config_mod.PRESETS, "tiny_conv", _tiny_conv_preset)
     real = overlap.declared_bucket_collectives
 
-    def drifted(specs, out_specs=None):
-        return real(specs, out_specs) + ["all_to_all@data"]
+    def drifted(specs, out_specs=None, reduce_axes=("data", "fsdp")):
+        return real(specs, out_specs, reduce_axes=reduce_axes) \
+            + ["all_to_all@data"]
 
     monkeypatch.setattr(overlap, "declared_bucket_collectives", drifted)
     findings, _ = collectives.run_collectives(["tiny_conv"])

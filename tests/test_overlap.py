@@ -114,6 +114,146 @@ def test_overlap_composes_with_fused_multi_step(devices):
 
 
 # ---------------------------------------------------------------------------
+# gradient accumulation inside the exchange body
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(data=8),
+    MeshConfig(data=4, fsdp=2),
+], ids=["dp", "dp_fsdp"])
+def test_accum_bucketed_is_bit_identical_and_wire_is_1x(mesh_cfg):
+    """The acceptance claim for the accumulation scan: many-vs-one-bucket
+    accumulated exchanges are BITWISE equal (bucketing stays a pure
+    scheduling change with the scan inside the body), and the recorded
+    per-step wire bytes equal the gradient bytes ONCE — 1/accum of what
+    a per-microbatch exchange would move."""
+    batches = _fixed_batches()
+    kw = {"comm.overlap": "on", "train.grad_accum_steps": "2"}
+    many, m1 = _train(mesh_cfg, batches, **{"comm.bucket_mb": "0.05", **kw})
+    plan = overlap_stats.snapshot()
+    assert plan["buckets"] > 1 and plan["accum_steps"] == 2
+    assert plan["wire_bytes"] == plan["grad_bytes"]  # ONE exchange/step
+    one, m2 = _train(mesh_cfg, batches, **{"comm.bucket_mb": "4096", **kw})
+    assert overlap_stats.snapshot()["buckets"] == 1
+    np.testing.assert_array_equal(many, one)
+    assert float(m1["loss"]) == float(m2["loss"])
+
+
+def test_accum_matches_composition_matched_jit_oracle(devices):
+    """The accumulated exchange vs the plain jit accumulation scan. The
+    body slices microbatches PER SHARD (each shard's local batch splits
+    into accum slices — no cross-shard reshard), while the jit scan
+    slices the global batch contiguously; permuting the oracle's batch to
+    the body's composition makes the two runs the same math: loss/ce
+    agree to float equality, params to float rounding (the accumulation
+    summation orders differ)."""
+    shards, bs, accum = 8, 16, 2
+    lb = bs // shards
+    mbl = lb // accum
+    perm = np.array([k * lb + m * mbl + j
+                     for m in range(accum)
+                     for k in range(shards)
+                     for j in range(mbl)])
+    batches = _fixed_batches()
+    permuted = [{"images": b["images"][perm], "labels": b["labels"][perm]}
+                for b in batches]
+    over, mo = _train(MeshConfig(data=8), batches,
+                      **{"comm.overlap": "on", "comm.bucket_mb": "0.05",
+                         "train.grad_accum_steps": "2"})
+    cfg = _tiny_cfg(**{"comm.overlap": "off", "train.grad_accum_steps": "2"})
+    tr = Trainer(cfg, mesh=create_mesh(MeshConfig(data=8)))
+    tr.init_state()
+    state, mj = tr.train(iter(permuted), num_steps=len(permuted))
+    base = _flat_params(state)
+    assert abs(float(mo["loss"]) - float(mj["loss"])) < 1e-6
+    assert abs(float(mo["cross_entropy"]) - float(mj["cross_entropy"])) \
+        < 1e-6
+    np.testing.assert_allclose(over, base, rtol=2e-3, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# transformer-family legs (the layout-aware exchange)
+# ---------------------------------------------------------------------------
+
+def _vit_cfg(experts=0, **kw):
+    cfg = _tiny_cfg()
+    cfg.model.name = "vit"
+    cfg.model.vit_patch_size = 4
+    cfg.model.vit_dim = 16
+    cfg.model.vit_depth = 4
+    cfg.model.vit_heads = 2
+    cfg.model.vit_num_experts = experts
+    cfg.optimizer.name = "adam"
+    cfg.optimizer.learning_rate = 1e-3
+    cfg.optimizer.weight_decay = 0.0
+    for k, v in kw.items():
+        cfg.override(k, v)
+    return cfg
+
+
+def _mesh_subset(mesh_cfg):
+    import math
+    n = math.prod(max(1, s) for s in (
+        mesh_cfg.data, mesh_cfg.fsdp, mesh_cfg.tensor, mesh_cfg.pipeline,
+        mesh_cfg.sequence, mesh_cfg.expert))
+    return create_mesh(mesh_cfg, devices=jax.devices()[:n])
+
+
+@pytest.mark.parametrize("mesh_cfg,experts,expect_axes", [
+    (MeshConfig(data=4, tensor=2), 0, {"data+fsdp"}),
+    (MeshConfig(data=2, pipeline=2), 0,
+     {"data+fsdp", "data+fsdp+pipeline"}),
+    (MeshConfig(data=2, pipeline=2, expert=2), 2,
+     {"data+fsdp", "data+fsdp+expert", "data+fsdp+pipeline+expert"}),
+], ids=["dp_tp", "dp_pp", "dp_pp_ep"])
+def test_vit_overlap_legs_match_default_path(mesh_cfg, experts,
+                                             expect_axes):
+    """The transformer legs of the universal envelope: the layout-aware
+    exchange (partial-auto tensor / inline pipeline / per-expert-group
+    buckets) must agree with the XLA-propagation step to float rounding,
+    and the plan's per-bucket reduce-axis sets must be exactly the
+    layout's expected partition of the leaves."""
+    mesh = _mesh_subset(mesh_cfg)
+
+    def run(overlap):
+        cfg = _vit_cfg(experts=experts,
+                       **{"comm.overlap": overlap,
+                          "comm.bucket_mb": "0.01"})
+        tr = Trainer(cfg, mesh=mesh)
+        tr.init_state()
+        state, metrics = tr.train(iter(_fixed_batches()), num_steps=4)
+        return _flat_params(state), metrics
+
+    base, mb = run("off")
+    over, mo = run("on")
+    plan = overlap_stats.snapshot()
+    assert set(plan["bucket_reduce_axes"]) == expect_axes, plan
+    np.testing.assert_allclose(over, base, rtol=5e-3, atol=5e-5)
+    assert abs(float(mo["loss"]) - float(mb["loss"])) < 5e-4
+
+
+def test_vit_overlap_bucketing_bit_identical_dp_pp_ep(devices):
+    """Many-vs-one-bucket on the MoE pipeline layout: grouped buckets
+    (one reduce-axis set each) are still a pure scheduling change."""
+    mesh = _mesh_subset(MeshConfig(data=2, pipeline=2, expert=2))
+
+    def run(bucket_mb):
+        cfg = _vit_cfg(experts=2, **{"comm.overlap": "on",
+                                     "comm.bucket_mb": bucket_mb})
+        tr = Trainer(cfg, mesh=mesh)
+        tr.init_state()
+        state, _ = tr.train(iter(_fixed_batches(n=2)), num_steps=2)
+        return _flat_params(state)
+
+    many = run("0.01")
+    assert overlap_stats.snapshot()["buckets"] > 3
+    one = run("4096")
+    # one bucket PER reduce-axis set is the floor — never fewer
+    assert overlap_stats.snapshot()["buckets"] == 3
+    np.testing.assert_array_equal(many, one)
+
+
+# ---------------------------------------------------------------------------
 # bucket planning
 # ---------------------------------------------------------------------------
 
@@ -125,6 +265,24 @@ def test_plan_buckets_reverse_order_and_cap():
     # everything fits: one bucket, still reverse order
     assert plan_buckets([1, 2, 3], 100) == [[2, 1, 0]]
     assert plan_buckets([], 8) == []
+
+
+def test_plan_buckets_grouped():
+    from distributed_resnet_tensorflow_tpu.parallel.overlap import (
+        plan_buckets_grouped)
+    A, B = ("data", "fsdp"), ("data", "fsdp", "expert")
+    # one group degenerates to plan_buckets (same buckets, same order)
+    assert plan_buckets_grouped([3, 3, 3, 3], [A] * 4, 6) == \
+        [(A, [3, 2]), (A, [1, 0])]
+    # mixed signatures never share a bucket, even under the byte cap;
+    # issue order follows the reversed position of each bucket's first
+    # leaf (backprop availability)
+    assert plan_buckets_grouped([3, 3, 3, 3], [A, B, A, B], 100) == \
+        [(B, [3, 1]), (A, [2, 0])]
+    # per-group caps still apply
+    assert plan_buckets_grouped([3, 3, 3, 3], [A, B, A, B], 3) == \
+        [(B, [3]), (A, [2]), (B, [1]), (A, [0])]
+    assert plan_buckets_grouped([], [], 8) == []
 
 
 # ---------------------------------------------------------------------------
@@ -141,11 +299,19 @@ def test_resolver_gates(devices):
     plan = resolve_overlap(_tiny_cfg(**{"comm.overlap": "on"}), mesh)
     assert plan is not None and plan.bucket_bytes == 4 * 2 ** 20
 
+    # gradient accumulation is IN-envelope now (the body owns the scan);
+    # the resolver only checks the microbatch divisibility
+    accum = _tiny_cfg(**{"comm.overlap": "on",
+                         "train.grad_accum_steps": "2"})
+    assert overlap_unsupported_reason(accum, mesh) is None
+    assert resolve_overlap(accum, mesh) is not None
+
     # unsupported combinations raise WITH the reason under "on"
     for kw, needle in [
-        ({"train.grad_accum_steps": "2"}, "grad_accum"),
         ({"model.cross_replica_bn": "false"}, "cross_replica_bn"),
         ({"train.batch_size": "12"}, "does not divide"),
+        # 16 divides 8 shards but not 8 shards × 3 microbatches
+        ({"train.grad_accum_steps": "3"}, "microbatches"),
     ]:
         bad = _tiny_cfg(**{"comm.overlap": "on", **kw})
         assert overlap_unsupported_reason(bad, mesh) is not None
@@ -155,10 +321,18 @@ def test_resolver_gates(devices):
         bad.comm.overlap = "auto"
         assert resolve_overlap(bad, mesh) is None
 
+    # the transformer family is in-envelope on batch/tensor/pipeline
+    # meshes now; the remaining refusals are the nesting-shard_map axes,
+    # each with its precise reason
     vit = _tiny_cfg(**{"comm.overlap": "on"})
     vit.model.name = "vit"
-    with pytest.raises(ValueError, match="transformer"):
-        Trainer(vit, mesh=mesh)
+    assert overlap_unsupported_reason(vit, mesh) is None
+    seq_mesh = create_mesh(MeshConfig(data=4, sequence=2))
+    assert "seq" in overlap_unsupported_reason(vit, seq_mesh)
+    ep_mesh = create_mesh(MeshConfig(data=4, expert=2))
+    assert "expert" in overlap_unsupported_reason(vit, ep_mesh)
+    tp_pp_mesh = create_mesh(MeshConfig(data=2, tensor=2, pipeline=2))
+    assert "tensor" in overlap_unsupported_reason(vit, tp_pp_mesh)
 
     # a single-shard mesh is what checkpoint consumers (evaluator, a
     # 1-device serving replica) see — a forced train-only knob must

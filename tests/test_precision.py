@@ -287,7 +287,37 @@ def test_compress_requires_overlap_warns_loudly(caplog, devices):
                for r in caplog.records)
     # unknown compress values are refused even with the exchange off
     with pytest.raises(ValueError, match="comm.compress"):
-        compress_dtype(_tiny_cfg(**{"comm.compress": "int8"}))
+        compress_dtype(_tiny_cfg(**{"comm.compress": "int4"}))
+
+
+def test_compress_and_zero1_compose_with_accumulation(caplog, devices):
+    """The converted warning branch: gradient accumulation used to force
+    the exchange off (comm.compress/optimizer.zero1 then warned and ran
+    full-f32 replicated) — it is IN-envelope now, so the composition must
+    build silently, compress the ONE per-step exchange (wire = grad/2),
+    scatter into the ZeRO-1 shard update and gather back bucketed, with
+    many-vs-one-bucket still bitwise equal."""
+    import logging
+    batches = _fixed_batches()
+    kw = {"comm.overlap": "on", "comm.compress": "bf16",
+          "optimizer.zero1": "on", "train.grad_accum_steps": "2"}
+    with caplog.at_level(logging.WARNING,
+                         logger="distributed_resnet_tensorflow_tpu.train.loop"):
+        tr, _, many, m1 = _train(MeshConfig(data=8), batches, **kw,
+                                 **{"comm.bucket_mb": "0.05"})
+    assert tr.comm_overlap_active and tr.comm_compress_active \
+        and tr.zero1_active
+    assert not any("comm.compress" in r.message and "overlap" in
+                   r.message for r in caplog.records)
+    plan = overlap_stats.snapshot()
+    assert plan["accum_steps"] == 2 and plan["compress"] == "bf16"
+    assert plan["wire_bytes"] * 2 == plan["grad_bytes"]  # halved, 1×/step
+    z1 = zero1_stats.snapshot()
+    assert z1["gather_compress"] == "bf16" and z1["gather_buckets"] >= 1
+    _, _, one, m2 = _train(MeshConfig(data=8), batches, **kw,
+                           **{"comm.bucket_mb": "4096"})
+    np.testing.assert_array_equal(many, one)
+    assert float(m1["loss"]) == float(m2["loss"])
 
 
 # ---------------------------------------------------------------------------
@@ -348,13 +378,71 @@ def test_resolve_serve_variants_strict():
     assert resolve_serve_variants(cfg) == ("f32",)
     cfg.serve.variants = ("bf16", "f32", "bf16")
     assert resolve_serve_variants(cfg) == ("bf16", "f32")  # deduped, ordered
-    cfg.serve.variants = ("int8",)
-    with pytest.raises(ValueError, match="int8"):
+    cfg.serve.variants = ("int8",)  # weight-only quantized serving
+    assert resolve_serve_variants(cfg) == ("int8",)
+    cfg.serve.variants = ("int4",)
+    with pytest.raises(ValueError, match="int4"):
         resolve_serve_variants(cfg)
     # CLI override coercion keeps string tuples as strings
     cfg2 = _tiny_cfg()
     cfg2.override("serve.variants", "f32,bf16")
     assert cfg2.serve.variants == ("f32", "bf16")
+
+
+#: pinned parity bound for the int8 weight-only variant vs the f32
+#: variant on the same params (docs/precision.md): per-output-channel
+#: symmetric quantization keeps serving logits within this relative L2
+INT8_PARITY_REL_L2 = 0.05
+
+
+def test_int8_quantizer_roundtrip_bound():
+    """Per-channel symmetric int8: dequantized weights sit within half a
+    quantization step of the original, per OUTPUT channel — the static
+    half of the serving parity bound."""
+    from distributed_resnet_tensorflow_tpu.parallel.precision import (
+        INT8_QMAX, dequantize_params, quantize_leaf_int8)
+    rng = np.random.RandomState(0)
+    w = (rng.randn(3, 3, 8, 16) * rng.rand(16) * 3).astype(np.float32)
+    q = quantize_leaf_int8(w)
+    assert q["int8_q"].dtype == jnp.int8 and q["int8_scale"].shape == (16,)
+    deq = dequantize_params({"k": q})["k"]
+    step = np.asarray(q["int8_scale"])
+    assert np.all(np.abs(np.asarray(deq) - w) <= step / 2 + 1e-7)
+    # scales are per-channel maxima / 127
+    np.testing.assert_allclose(
+        step, np.abs(w).max(axis=(0, 1, 2)) / float(INT8_QMAX), rtol=1e-6)
+
+
+def test_int8_variant_serves_within_parity_bound(tmp_path, devices):
+    """The int8 weight-only serving variant: kernels live int8-at-rest
+    (a real ~4× cut on quantized leaves), biases/norm leaves stay f32,
+    AOT warm covers the variant (no serve-time compile), and its logits
+    stay within the pinned parity bound of the f32 variant."""
+    from distributed_resnet_tensorflow_tpu.serve.server import (
+        InferenceServer)
+    cfg = _serve_cfg(tmp_path)
+    cfg.serve.variants = ("f32", "int8")
+    server = InferenceServer(cfg)
+    server.start(start_threads=False)
+    leaves = jax.tree_util.tree_leaves(server._states["int8"].params)
+    int8_bytes = sum(int(l.size) for l in leaves if l.dtype == jnp.int8)
+    f32_bytes = sum(int(l.size) * 4 for l in leaves
+                    if l.dtype == jnp.float32)
+    assert int8_bytes > 0 and int8_bytes > 4 * f32_bytes, \
+        (int8_bytes, f32_bytes)  # the kernels really are int8 at rest
+    rng = np.random.RandomState(0)
+    img = rng.randn(8, 8, 3).astype(np.float32)
+    fut32 = server.submit(img, variant="f32")
+    fut8 = server.submit(img, variant="int8")
+    served = 0
+    while served < 2:
+        served += server.service_once(block_secs=0.5)
+    row32, _ = fut32.result(timeout=5)
+    row8, _ = fut8.result(timeout=5)
+    rel = np.linalg.norm(row8 - row32) / (np.linalg.norm(row32) + 1e-9)
+    assert rel < INT8_PARITY_REL_L2, rel
+    assert server.cache.serve_time_compiles == 0
+    server.close()
 
 
 @pytest.mark.heavy
