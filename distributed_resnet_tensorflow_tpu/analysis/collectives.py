@@ -213,7 +213,7 @@ def run_collectives(preset_names: Optional[Sequence[str]] = None,
     from ..parallel.mesh import create_mesh
     from ..parallel.overlap import (overlap_stats,
                                     overlap_unsupported_reason)
-    from ..utils.config import PRESETS, get_preset
+    from ..utils.config import MeshConfig, PRESETS, get_preset
     from .elaborate import candidate_layouts, _abstract_batch, \
         _axis_product
 
@@ -427,6 +427,34 @@ def run_collectives(preset_names: Optional[Sequence[str]] = None,
 
                     record(name, label, "bf16+compress", build_compress,
                            deterministic_retrace=False, plan_check=True)
+
+        # (3b) reshard shrink topologies (docs/resilience.md): after an
+        # elastic shrink the SAME program is re-elaborated over the
+        # survivor sub-mesh, and every survivor traces it independently
+        # inside the reshard barrier — so the schedule on each shrunken
+        # topology must be deterministic across elaborations, and is
+        # pinned here per survivor count. One witness program (the
+        # det-probe) on the plain data layout: a shrink changes the
+        # device count and the per_host-rescaled global batch, never the
+        # program. 6 and 4 of 8 devices model losing one/two hosts of a
+        # four-host fleet with two devices each.
+        if name == _DET_PROBE:
+            per_dev = cfg.train.batch_size // n_devices
+            for shrink in (6, 4):
+
+                def build_shrink(cfg=cfg, shrink=shrink, per_dev=per_dev):
+                    sub_mesh = create_mesh(MeshConfig(data=shrink),
+                                           devices=jax.devices()[:shrink])
+                    scfg = copy.deepcopy(cfg)
+                    scfg.train.batch_size = per_dev * shrink
+                    trainer = _trainer_for(scfg, sub_mesh)
+                    state = _abstract_state(trainer, scfg)
+                    batch = _abstract_batch(scfg, scfg.train.batch_size)
+                    return extract_schedule(trainer._train_step, state,
+                                            batch)
+
+                record(name, "dp", f"reshard_s{shrink}", build_shrink,
+                       deterministic_retrace=True, plan_check=False)
 
         # (4) serve/predict step: smallest + largest AOT bucket on the
         # first layout — forward-only, so the signature pins that serving

@@ -62,6 +62,12 @@ THREAD_ROLES = {
     "resilience/heartbeat.py::HeartbeatPublisher._run": ROLE_DAEMON,
     "resilience/watchdog.py::Watchdog._run": ROLE_DAEMON,
     "serve/swap.py::CheckpointSwapper._run": ROLE_DAEMON,
+    # the reshard teardown's bounded jax.distributed.shutdown: shutting
+    # down the dead generation's coordination client can block on a lost
+    # peer, so it runs on a joined-with-timeout daemon and is abandoned
+    # past the deadline (docs/resilience.md, elastic mesh)
+    "parallel/distributed.py::teardown_for_reshard.<locals>._shutdown":
+        ROLE_DAEMON,
 }
 
 #: entry points that constitute the LOOP/DISPATCH side for the blocking-

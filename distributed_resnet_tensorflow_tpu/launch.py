@@ -73,32 +73,48 @@ CLEAN_EXIT_GRACE_FLOOR_SECS = 300.0
 CLEAN_EXIT_GRACE_SCALE = 10.0
 
 
+def _spawn_one(pid: int, num_processes: int, main_args: List[str],
+               devices_per_process: int, port: int,
+               rejoin: bool = False) -> subprocess.Popen:
+    from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
+        virtual_cpu_env)
+    env = virtual_cpu_env(devices_per_process)
+    if rejoin:
+        # a replacement worker must not re-arm the fault that killed its
+        # predecessor, and enters through the elastic join barrier
+        # (resilience/elastic.py) instead of the dead generation's
+        # coordinator — main.py keys off DRT_ELASTIC_REJOIN
+        for key in [k for k in env if k.startswith("DRT_FAULT_")]:
+            env.pop(key)
+        env["DRT_ELASTIC_REJOIN"] = "1"
+    cmd = [sys.executable, "-m", "distributed_resnet_tensorflow_tpu.main",
+           *main_args,
+           "--set", f"mesh.coordinator_address=127.0.0.1:{port}",
+           "--set", f"mesh.num_processes={num_processes}",
+           "--set", f"mesh.process_id={pid}"]
+    # chief inherits stdout/stderr; others keep their own log files —
+    # per-process logs like the reference's worker.$JOBID.$host.log
+    # (reference run_dist_train_eval_daint.sh:161,188)
+    if pid == 0:
+        out = None
+    else:
+        os.makedirs("/tmp/drt_launch", exist_ok=True)
+        out = open(f"/tmp/drt_launch/proc{pid}.log",
+                   "a" if rejoin else "w")
+    return subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+
+
 def _spawn(num_processes: int, main_args: List[str],
            devices_per_process: int, port: int) -> List[subprocess.Popen]:
     from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
-        existing_device_count, virtual_cpu_env)
+        existing_device_count)
 
     if not devices_per_process:
         devices_per_process = existing_device_count(
             os.environ.get("XLA_FLAGS", "")) or 1
-    procs = []
-    for pid in range(num_processes):
-        env = virtual_cpu_env(devices_per_process)
-        cmd = [sys.executable, "-m", "distributed_resnet_tensorflow_tpu.main",
-               *main_args,
-               "--set", f"mesh.coordinator_address=127.0.0.1:{port}",
-               "--set", f"mesh.num_processes={num_processes}",
-               "--set", f"mesh.process_id={pid}"]
-        # chief inherits stdout/stderr; others keep their own log files —
-        # per-process logs like the reference's worker.$JOBID.$host.log
-        # (reference run_dist_train_eval_daint.sh:161,188)
-        if pid == 0:
-            out = None
-        else:
-            os.makedirs("/tmp/drt_launch", exist_ok=True)
-            out = open(f"/tmp/drt_launch/proc{pid}.log", "w")
-        procs.append(subprocess.Popen(cmd, env=env, stdout=out, stderr=out))
-    return procs
+    return [_spawn_one(pid, num_processes, main_args, devices_per_process,
+                       port)
+            for pid in range(num_processes)]
 
 
 def _signal_all(procs: List[subprocess.Popen], sig: int,
@@ -148,7 +164,10 @@ def launch_local(num_processes: int, main_args: List[str],
                  devices_per_process: int = 0, port: int = 8476,
                  child_grace_secs: float = DEFAULT_CHILD_GRACE_SECS,
                  poll_secs: float = 0.2,
-                 procs_out: Optional[list] = None) -> int:
+                 procs_out: Optional[list] = None,
+                 elastic: bool = False,
+                 max_respawns: int = 2,
+                 respawn_delay_secs: float = 2.0) -> int:
     """Spawn N copies of main.py on localhost over the loopback coordinator
     and supervise them to completion (see module docstring for the exit-code
     aggregation). ``devices_per_process=0`` (default) honors a device count
@@ -156,7 +175,21 @@ def launch_local(num_processes: int, main_args: List[str],
 
     ``procs_out``: optional list the spawned Popen objects are appended to —
     the fault-injection tests need the children's pids to kill one
-    (tests/test_resilience.py kill-and-detect)."""
+    (tests/test_resilience.py kill-and-detect).
+
+    ``elastic``: respawn a child that died respawnable (signal death or
+    exit 75) into its ORIGINAL slot with ``DRT_ELASTIC_REJOIN`` set, up to
+    ``max_respawns`` times per slot — the replacement joins the live
+    fleet's elastic barrier and the mesh grows back
+    (resilience/elastic.py). Requires ``resilience.elastic.enabled=on`` in
+    ``main_args``; respawnable deaths do NOT arm the bad-exit teardown
+    countdown in this mode (the survivors are busy resharding, not
+    wedged). A slot's FINAL incarnation decides its exit code."""
+    from distributed_resnet_tensorflow_tpu.utils.virtual_devices import (
+        existing_device_count)
+    if not devices_per_process:
+        devices_per_process = existing_device_count(
+            os.environ.get("XLA_FLAGS", "")) or 1
     procs = _spawn(num_processes, main_args, devices_per_process, port)
     if procs_out is not None:
         procs_out.extend(procs)
@@ -178,13 +211,47 @@ def launch_local(num_processes: int, main_args: List[str],
     first_exit_at: Optional[float] = None
     first_bad_exit_at: Optional[float] = None
     termed_at: Optional[float] = None
+    respawns = [0] * num_processes
+    pending_respawn: dict = {}  # slot -> monotonic due time
     try:
         while True:
             codes = [p.poll() for p in procs]
+            now = time.monotonic()
+            if elastic and termed_at is None:
+                any_clean = any(c == 0 for c in codes)
+                for i, c in enumerate(codes):
+                    if c is None or i in pending_respawn or i in forced:
+                        continue
+                    if any_clean:
+                        continue  # the run is finishing — no new workers
+                    if (c < 0 or c == RESUMABLE_EXIT_CODE) and \
+                            respawns[i] < max_respawns:
+                        respawns[i] += 1
+                        pending_respawn[i] = now + respawn_delay_secs
+                        log.warning(
+                            "elastic: child %d died respawnable (code %d); "
+                            "respawning as a rejoiner in %.0fs "
+                            "(attempt %d/%d)", i, c, respawn_delay_secs,
+                            respawns[i], max_respawns)
+                for i, due in list(pending_respawn.items()):
+                    if now >= due:
+                        procs[i] = _spawn_one(
+                            i, num_processes, main_args,
+                            devices_per_process, port, rejoin=True)
+                        if procs_out is not None:
+                            procs_out.append(procs[i])
+                        del pending_respawn[i]
+                # slots awaiting (or fresh from) respawn are not exits for
+                # the teardown timers; with everyone live again the
+                # countdown state resets — the fleet recovered
+                codes = [None if i in pending_respawn else p.poll()
+                         for i, p in enumerate(procs)]
+                if all(c is None for c in codes):
+                    first_exit_at = None
+                    first_bad_exit_at = None
             live = [i for i, c in enumerate(codes) if c is None]
             if not live:
                 break
-            now = time.monotonic()
             if first_exit_at is None and any(c is not None for c in codes):
                 first_exit_at = now
             # a deliberate resumable exit (75) is not a failure: during a
@@ -256,6 +323,16 @@ def main(argv=None):
                          "the first BAD (non-resumable nonzero / signal) "
                          "child exit, before SIGTERM/SIGKILL; clean/75 "
                          "exits arm a 10x/300s-floor backstop instead")
+    ap.add_argument("--elastic", action="store_true",
+                    help="respawn a child that died respawnable (signal "
+                         "or exit 75) into its slot as an elastic "
+                         "rejoiner (DRT_ELASTIC_REJOIN); pair with "
+                         "--set resilience.elastic.enabled=on")
+    ap.add_argument("--max_respawns", type=int, default=2,
+                    help="per-slot respawn budget in --elastic mode")
+    ap.add_argument("--respawn_delay_secs", type=float, default=2.0,
+                    help="delay before an elastic respawn (lets the "
+                         "survivors reach the join barrier first)")
     ap.add_argument("main_args", nargs=argparse.REMAINDER,
                     help="args after -- go to main.py")
     ns = ap.parse_args(argv)
@@ -264,7 +341,10 @@ def main(argv=None):
         main_args = main_args[1:]
     sys.exit(launch_local(ns.num_processes, main_args,
                           ns.devices_per_process, ns.port,
-                          child_grace_secs=ns.child_grace_secs))
+                          child_grace_secs=ns.child_grace_secs,
+                          elastic=ns.elastic,
+                          max_respawns=ns.max_respawns,
+                          respawn_delay_secs=ns.respawn_delay_secs))
 
 
 if __name__ == "__main__":

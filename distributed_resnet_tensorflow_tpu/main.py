@@ -21,6 +21,7 @@ import contextlib
 import logging
 import os
 import sys
+import time
 from typing import Optional
 
 import jax
@@ -30,8 +31,10 @@ from .data import create_input_iterator
 from .evaluator import Evaluator, make_eval_iterator
 from .parallel import initialize_from_config, is_chief
 from .resilience import Preempted, PreemptionListener, RESUMABLE_EXIT_CODE
+from .resilience.elastic import (ElasticImpossible, ElasticRuntime,
+                                 ReshardRequired)
 from .resilience.heartbeat import (PHASE_DONE, PHASE_FAILED,
-                                   PHASE_PREEMPTED)
+                                   PHASE_PREEMPTED, PHASE_RESHARD)
 from .resilience.preemption import (collective_preempted,
                                     collective_should_stop)
 from .resilience.faultinject import maybe_wrap_from_env
@@ -107,7 +110,7 @@ def _make_train_source(cfg: ExperimentConfig, trainer: Trainer):
 
 def _start_watchdog(cfg: ExperimentConfig, writer, listener,
                     trainer: Optional[Trainer] = None,
-                    role: str = "train"):
+                    role: str = "train", elastic=None):
     """Build + start the heartbeat publisher and the health watchdog
     (resilience/heartbeat.py, resilience/watchdog.py) when enabled —
     ``resilience.watchdog.enabled=auto`` resolves to on iff the run has
@@ -139,7 +142,11 @@ def _start_watchdog(cfg: ExperimentConfig, writer, listener,
     transport = FileBeatTransport(hb_dir, jax.process_index())
     publisher = HeartbeatPublisher(
         transport, jax.process_index(),
-        interval_secs=wd_cfg.interval_secs).start()
+        interval_secs=wd_cfg.interval_secs,
+        # beats are generation-stamped so the monitor (and a peer's
+        # straggler accounting) can tell a live host of generation g from
+        # a stale file of generation g-1 (resilience/elastic.py)
+        generation=elastic.generation if elastic is not None else 0).start()
     if trainer is not None:
         trainer.heartbeat = publisher
     watchdog = Watchdog(
@@ -150,6 +157,10 @@ def _start_watchdog(cfg: ExperimentConfig, writer, listener,
         # step-time outlier detector rides the watchdog's detection thread
         anomaly_cfg=cfg.telemetry,
     ).start()
+    if elastic is not None:
+        # escalation fork: a peer-lost verdict defers its hard exit while
+        # this process can reshard instead (resilience/watchdog.py)
+        watchdog.set_elastic(elastic.watchdog_defer)
     log.info("health watchdog armed: %d processes, beats -> %s "
              "(peer_timeout %.0fs, grace %.0fs)", jax.process_count(),
              hb_dir, wd_cfg.peer_timeout_secs, wd_cfg.grace_secs)
@@ -170,26 +181,39 @@ def _teardown_watchdog(publisher, watchdog, final_phase: str) -> None:
 @contextlib.contextmanager
 def _watchdog_session(cfg: ExperimentConfig, writer, listener,
                       trainer: Optional[Trainer] = None,
-                      role: str = "train"):
+                      role: str = "train", elastic=None):
     """The teardown choreography every entry point needs, in ONE place:
     success publishes a final ``done`` beat, Preempted publishes
     ``preempted`` (clean coordinated departure — peers must not flag us as
     lost), and any other error first asks the watchdog whether a PEER
     caused it (exits with the verdict code; does not return) before
-    publishing ``failed``. Yields (publisher, watchdog), both None when
-    the watchdog is disabled."""
+    publishing ``failed``. With a live elastic runtime the peer-lost exit
+    becomes a :class:`ReshardRequired` unwind instead, leaving through the
+    ``reshard`` final phase (a coordinated departure into the next mesh
+    generation — resilience/elastic.py). Yields (publisher, watchdog),
+    both None when the watchdog is disabled."""
     publisher, watchdog = _start_watchdog(cfg, writer, listener, trainer,
-                                          role=role)
+                                          role=role, elastic=elastic)
     try:
         yield publisher, watchdog
     except Preempted:
         _teardown_watchdog(publisher, watchdog, PHASE_PREEMPTED)
         raise
+    except ReshardRequired:
+        # the grow path raises from the step loop itself (post-loop fork
+        # in _train_one_generation): a clean departure into the barrier
+        _teardown_watchdog(publisher, watchdog, PHASE_RESHARD)
+        raise
     except BaseException as e:
         if isinstance(e, Exception):
             # a collective error caused by a dead peer exits 75 here
-            # (does not return); our OWN errors fall through and propagate
-            _exit_for_peer_failure(watchdog, e)
+            # (does not return) — or, elastic, unwinds into the reshard
+            # barrier; our OWN errors fall through and propagate
+            try:
+                _exit_for_peer_failure(watchdog, e, elastic=elastic)
+            except ReshardRequired as rr:
+                _teardown_watchdog(publisher, watchdog, PHASE_RESHARD)
+                raise rr from e
         _teardown_watchdog(publisher, watchdog, PHASE_FAILED)
         raise
     else:
@@ -232,12 +256,20 @@ def _collective_shaped(exc: BaseException) -> bool:
     return any(m in text for m in _COLLECTIVE_ERROR_MARKERS)
 
 
-def _exit_for_peer_failure(watchdog, exc: BaseException):
+def _exit_for_peer_failure(watchdog, exc: BaseException, elastic=None):
     """After a runtime error in a multi-process step: if the beats say a
     peer died or reported failure, exit with the watchdog's verdict code
     (75 = peer loss, requeue; 1 = peer's real failure) instead of letting
     the exception propagate into the atexit ``jax.distributed.shutdown``
     barrier — which would block on the very peers that are gone.
+
+    With a live elastic runtime, a peer-LOST verdict raises
+    :class:`ReshardRequired` instead — the shrink entry: the survivors
+    meet in the join barrier and continue as a smaller mesh generation;
+    exit 75 is now the FALLBACK for when that is impossible
+    (docs/resilience.md). A peer-FAILED verdict (the peer reported its
+    own real error) still exits 1 — resharding around a determinism bug
+    would silently change the experiment.
 
     Collective-shaped errors poll the beats up to the watchdog's default
     wait (the error can surface milliseconds after the peer died, before
@@ -250,6 +282,12 @@ def _exit_for_peer_failure(watchdog, exc: BaseException):
         wait_secs=None if _collective_shaped(exc) else 0.0)
     if verdict is not None:
         kind, code, detail = verdict
+        if kind == "peer_lost" and elastic is not None \
+                and elastic.can_reshard():
+            log.warning("peer loss behind %r — entering the elastic "
+                        "reshard barrier instead of exit 75 (%s)",
+                        exc, detail)
+            raise ReshardRequired("peer_lost", detail)
         log.error("step loop error attributed to a peer (%s): %r",
                   kind, exc)
         watchdog.exit_now(kind, code, detail)  # does not return
@@ -333,18 +371,157 @@ def _check_resume_config(cfg: ExperimentConfig) -> None:
             _json.dump(now, f, indent=1, sort_keys=True)
 
 
+def _newest_committed_step(cfg: ExperimentConfig) -> Optional[int]:
+    """The step a new mesh generation restores from: the newest COMMITTED
+    checkpoint. The committing chief pins this into the barrier record
+    (resilience/elastic.py) so survivors and rejoiners restore the EXACT
+    same step with no post-teardown agreement collective."""
+    from .resilience.manifest import committed_steps
+    steps = committed_steps(resolve_checkpoint_dir(cfg))
+    return steps[-1] if steps else None
+
+
 def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
-    """Build → (maybe) restore → train with hooks. Returns (state, metrics).
+    """Train across MESH GENERATIONS. Returns (state, metrics).
 
     Resilience wiring (docs/resilience.md): a PreemptionListener stops the
     loop at a step boundary on SIGTERM/SIGINT or a config deadline, commits
     a final checkpoint, and raises Preempted (main() maps it to exit code
     75); the NaN sentinel rolls back to the last good checkpoint with LR
-    back-off when the guard trips."""
-    trainer = Trainer(cfg)
-    trainer.init_state()
-    _check_resume_config(cfg)
+    back-off when the guard trips.
+
+    With ``resilience.elastic.enabled=on`` (resilience/elastic.py) a lost
+    peer no longer ends the job: the step loop unwinds here with
+    :class:`ReshardRequired`, the survivors meet in a file-based join
+    barrier, tear down the dead jax world, re-initialize over the new
+    membership at an epoch-suffixed coordinator, and the next iteration of
+    this loop rebuilds the Trainer (every sharding rule re-elaborates
+    against the shrunken topology) and restores the committed step the
+    barrier pinned. A respawned worker (launch.py --elastic sets
+    ``DRT_ELASTIC_REJOIN``) enters the SAME loop through ``rejoin()`` and
+    the fleet grows back. Exit 75 remains the fallback whenever the
+    transition is impossible (chief lost, < min_hosts, barrier timeout,
+    generation budget, non-elastic layout)."""
     res = cfg.resilience
+    rejoin = bool(os.environ.get("DRT_ELASTIC_REJOIN"))
+    if rejoin:
+        # identity comes from the launcher slot (--set mesh.process_id):
+        # there is no live jax world to ask yet
+        runtime = ElasticRuntime(cfg)
+    else:
+        runtime = ElasticRuntime(cfg, worker_id=jax.process_index(),
+                                 num_processes=jax.process_count())
+    if not runtime.enabled:
+        runtime = None
+    if rejoin and runtime is None:
+        raise RuntimeError(
+            "DRT_ELASTIC_REJOIN is set but resilience.elastic is off or "
+            "the run has no peers — nothing to rejoin")
+
+    listener = None
+    if res.handle_signals:
+        listener = PreemptionListener(deadline_secs=res.deadline_secs)
+        if not listener.install():
+            listener = None  # not the main thread — run without handlers
+
+    gen_cfg = cfg
+    record = None
+    reshard_info = None
+    if rejoin:
+        from .parallel.distributed import reinitialize
+        try:
+            # the restore_step_fn covers the whole-fleet-died case: every
+            # worker rejoins and the rejoined chief commits the round — it
+            # must pin the newest committed checkpoint like a survivor would
+            record = runtime.rejoin(lambda: _newest_committed_step(cfg))
+        except ElasticImpossible as e:
+            # the supervisor respawns on 75 with a bounded budget —
+            # re-posting the join later beats failing the slot for good
+            log.error("elastic rejoin failed (%s); exiting resumable",
+                      e.reason)
+            raise Preempted(0, f"rejoin failed: {e.reason}")
+        reinitialize(record["coordinator"], len(record["members"]),
+                     runtime.rank(record))
+        gen_cfg = runtime.derive_config(record)
+
+    try:
+        while True:
+            try:
+                return _train_one_generation(
+                    gen_cfg, listener, max_steps, runtime=runtime,
+                    record=record, reshard_info=reshard_info)
+            except ReshardRequired as rr:
+                from .parallel.distributed import (reinitialize,
+                                                   teardown_for_reshard)
+                from .telemetry.tracer import span
+                old_hosts = len(runtime.members)
+                t0 = time.monotonic()
+                try:
+                    with span("reshard.barrier", category="reshard"):
+                        record = runtime.transition(
+                            rr.reason,
+                            lambda: _newest_committed_step(gen_cfg))
+                except ElasticImpossible as e:
+                    # the requeue contract is the FALLBACK: a mesh that
+                    # cannot reshard leaves exactly the way the watchdog
+                    # always did — hard resumable exit, no distributed
+                    # shutdown barrier against peers that are gone
+                    log.error("elastic reshard impossible (%s) — exiting "
+                              "resumable for the requeue contract",
+                              e.reason)
+                    logging.shutdown()
+                    os._exit(e.exit_code)
+                barrier_ms = (time.monotonic() - t0) * 1000.0
+                with span("reshard.teardown", category="reshard"):
+                    teardown_for_reshard(runtime.ecfg.teardown_timeout_secs)
+                with span("reshard.init", category="reshard"):
+                    reinitialize(record["coordinator"],
+                                 len(record["members"]),
+                                 runtime.rank(record))
+                gen_cfg = runtime.derive_config(record)
+                if listener is not None:
+                    # the old generation's stop request (watchdog peer-lost
+                    # escalation / the chief's grow request) is consumed;
+                    # a real SIGTERM survives the reset
+                    listener.reset()
+                reshard_info = {
+                    "generation": record["generation"],
+                    "reason": rr.reason,
+                    "old_hosts": old_hosts,
+                    "new_hosts": len(record["members"]),
+                    "restore_step": record["restore_step"],
+                    "global_batch": record["global_batch"],
+                    "barrier_ms": round(barrier_ms, 1),
+                    "_t0": t0,  # total_ms completes once the mesh is live
+                }
+                log.warning(
+                    "elastic: generation %d -> %d (%s): %d -> %d hosts, "
+                    "restore step %s, global batch %s",
+                    record["generation"] - 1, record["generation"],
+                    rr.reason, old_hosts, len(record["members"]),
+                    record["restore_step"], record["global_batch"])
+    finally:
+        if listener is not None:
+            listener.uninstall()
+
+
+def _train_one_generation(cfg: ExperimentConfig, listener,
+                          max_steps: Optional[int], runtime=None,
+                          record=None, reshard_info=None):
+    """Build → (maybe) restore → train with hooks for ONE mesh generation
+    (the whole job, when elastic is off). Returns (state, metrics);
+    raises ReshardRequired to unwind into run_train's generation loop."""
+    from .telemetry.tracer import span
+    res = cfg.resilience
+    rebuild_span = span("reshard.rebuild", category="reshard") \
+        if record is not None else contextlib.nullcontext()
+    with rebuild_span:
+        trainer = Trainer(cfg)
+        trainer.init_state()
+    if record is None:
+        # generation transitions deliberately change world size/batch —
+        # re-running the recipe-drift check would warn on every reshard
+        _check_resume_config(cfg)
 
     manager = CheckpointManager(
         resolve_checkpoint_dir(cfg), max_to_keep=cfg.checkpoint.max_to_keep,
@@ -358,8 +535,26 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
         finalize_timeout_secs=cfg.checkpoint.finalize_timeout_secs)
 
     start_step = 0
-    if cfg.checkpoint.resume:
-        from .telemetry.tracer import span
+    if record is not None and int(record.get("restore_step", -1)) >= 0:
+        # the barrier pinned the step: every member of the new generation
+        # restores it EXACTLY — through the sharded M≠N assemble path
+        # (checkpoint/shards.py) when the layout changed under it
+        with span("reshard.restore", category="reshard"):
+            trainer.state, restored = manager.restore(
+                trainer.state, step=int(record["restore_step"]))
+        if restored is None:
+            raise RuntimeError(
+                f"generation {runtime.generation}: committed restore step "
+                f"{record['restore_step']} failed to restore — the "
+                "generations would diverge")
+        start_step = int(trainer.state.step)
+        log.info("generation %d: restored committed step %d into the new "
+                 "mesh layout", runtime.generation, start_step)
+    elif record is not None:
+        log.warning("generation %d: no committed checkpoint existed at the "
+                    "transition — restarting from step 0 on the new mesh",
+                    runtime.generation)
+    elif cfg.checkpoint.resume:
         with span("restore"):
             trainer.state, restored = manager.restore(trainer.state)
         if restored is not None:
@@ -455,21 +650,28 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
     if cfg.checkpoint.save_every_steps or cfg.checkpoint.save_every_secs:
         hooks.append(CheckpointHook(manager))
 
-    listener = None
-    if res.handle_signals:
-        listener = PreemptionListener(deadline_secs=res.deadline_secs)
-        if not listener.install():
-            listener = None  # not the main thread — run without handlers
-
     num_steps = max_steps if max_steps is not None else cfg.train.train_steps
     try:
         # distributed health watchdog: every process beats; peer loss /
-        # hangs escalate to a coordinated stop, then exit 75
+        # hangs escalate to a coordinated stop, then exit 75 — or, with
+        # elastic on, a reshard into the next generation
         # (docs/resilience.md); the session publishes the final
-        # done/preempted/failed beat on every exit path
-        with _watchdog_session(cfg, writer, listener, trainer) \
+        # done/preempted/failed/reshard beat on every exit path
+        with _watchdog_session(cfg, writer, listener, trainer,
+                               elastic=runtime) \
                 as (publisher, watchdog):
             _arm_watchdog_hooks(hooks, publisher)
+            if runtime is not None:
+                if reshard_info is not None and writer is not None:
+                    info = dict(reshard_info)
+                    t0 = info.pop("_t0", None)
+                    if t0 is not None:
+                        info["total_ms"] = round(
+                            (time.monotonic() - t0) * 1000.0, 1)
+                    writer.write_event("reshard", info)
+                # generation.json + heartbeat tombstones + the
+                # mesh_generation row: the new mesh is about to step
+                runtime.mark_live(record, start_step, writer)
             stop_fn = None
             if listener is not None:
                 # multi-process: the stop decision must flip at the SAME
@@ -477,6 +679,17 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
                 # barrier deadlocks (preemption.py collective_should_stop)
                 stop_fn = collective_should_stop(listener) \
                     if jax.process_count() > 1 else listener.should_stop
+                if runtime is not None and jax.process_index() == 0:
+                    # chief's between-steps grow poll: a rejoiner posting
+                    # into the next round stops the fleet at a step
+                    # boundary through the NORMAL collective agreement;
+                    # the post-loop fork below turns the stop into a grow
+                    base_stop = stop_fn
+
+                    def stop_fn():
+                        if runtime.pending_join():
+                            listener.request_stop("reshard")
+                        return base_stop()
             # NOTE: the phase stays "init" (unmonitored) until the FIRST
             # step completes and HeartbeatHook flips it to "train" — the
             # first step includes XLA compilation, which can legitimately
@@ -517,13 +730,34 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
                 # checkpointing is off): the whole point of a graceful stop
                 # is that a relaunch resumes instead of restarting
                 step = int(state.step)
+                reason = listener.reason()
+                if (runtime is not None and runtime.can_reshard()
+                        and not reason.startswith("signal ")
+                        and reason != "deadline"
+                        and runtime.pending_join(force=True)):
+                    # GROW fork: the stop was the chief's reshard request
+                    # (reason "reshard" there, its collective mirror "peer
+                    # preempted" elsewhere — both non-signal) and a join
+                    # for the next round is pending. Every process reads
+                    # the same files + config, so the fork agrees; commit
+                    # a checkpoint for the next generation to restore and
+                    # unwind into the barrier
+                    if publisher is not None:
+                        publisher.set_phase("save")
+                    manager.save(step, state, force=True)
+                    manager.wait_until_finished()
+                    log.info("elastic: grow requested — checkpoint "
+                             "committed at step %d; entering the join "
+                             "barrier", step)
+                    raise ReshardRequired("grow",
+                                          f"pending join at step {step}")
                 if publisher is not None:
                     publisher.set_phase("save")
                 manager.save(step, state, force=True)
                 manager.wait_until_finished()
                 log.warning("preempted (%s): checkpoint committed at step "
-                            "%d; exiting resumable", listener.reason(), step)
-                raise Preempted(step, listener.reason())
+                            "%d; exiting resumable", reason, step)
+                raise Preempted(step, reason)
             # final checkpoint + drain async saves
             if cfg.checkpoint.save_every_steps or \
                     cfg.checkpoint.save_every_secs:
@@ -531,8 +765,8 @@ def run_train(cfg: ExperimentConfig, max_steps: Optional[int] = None):
                     publisher.set_phase("save")
                 manager.save(int(state.step), state, force=True)
     finally:
-        if listener is not None:
-            listener.uninstall()
+        # the listener is NOT uninstalled here — run_train owns it across
+        # generations (a SIGTERM mid-reshard must still be caught)
         manager.close()
         if shard_writer is not None and shard_writer is not writer:
             shard_writer.close()  # the non-chief ckpt_shard stream
@@ -849,7 +1083,15 @@ def main(argv=None):
         from .analysis.dispatch_sanitizer import install as _install_ds
         _install_ds()
         log.info("dispatch sanitizer armed (analysis.dispatch_sanitizer)")
-    initialize_from_config(cfg.mesh)
+    if os.environ.get("DRT_ELASTIC_REJOIN"):
+        # elastic rejoin (resilience/elastic.py): the generation this
+        # worker died in is gone and its coordinator port with it —
+        # run_train joins the live fleet's barrier and initializes into
+        # the NEXT generation instead of the config's dead world
+        log.info("elastic rejoin: deferring distributed init to the "
+                 "join barrier")
+    else:
+        initialize_from_config(cfg.mesh)
     log.info("devices: %d (%d processes)", jax.device_count(),
              jax.process_count())
     try:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -140,3 +141,90 @@ def is_chief() -> bool:
     """Process 0 — successor of the reference's ``is_chief = task_index == 0``
     (reference resnet_cifar_main.py:323-335)."""
     return jax.process_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# Elastic mesh generations (resilience/elastic.py; docs/resilience.md).
+# A mesh GENERATION is one (membership, coordinator) epoch of the job.
+# Every generation gets its own coordinator endpoint — the old service may
+# linger half-dead on the chief (its shutdown blocks on the lost peer and
+# is abandoned, below), so generation g must bind somewhere fresh.
+# ---------------------------------------------------------------------------
+
+def elastic_coordinator(base_address: str, generation: int,
+                        port_stride: int = 7) -> str:
+    """The epoch-suffixed coordinator contract: generation ``g`` lives at
+    the base coordinator's host, port ``base + g * port_stride``.
+    Deterministic from (base, g) alone so survivors and rejoining peers
+    derive the SAME endpoint from the shared generation record without
+    any further coordination. The chief (worker 0) hosts every
+    generation's coordinator — a reshard that loses worker 0 is
+    infeasible and falls back to exit 75."""
+    host, _, port = base_address.rpartition(":")
+    if not host:
+        raise ValueError(
+            f"coordinator_address {base_address!r} has no host:port — "
+            "elastic generations need an explicit base endpoint")
+    return f"{host}:{int(port) + generation * port_stride}"
+
+
+def teardown_for_reshard(timeout_secs: float = 5.0) -> None:
+    """Tear down a distributed runtime whose peers may be DEAD so this
+    process can re-``initialize`` over the survivors.
+
+    ``jax.distributed.shutdown`` is a barrier — against a dead peer the
+    client's shutdown blocks forever, so it runs in an abandoned daemon
+    thread (it touches only the local client/service references, never
+    jax's global state, so giving up on it is safe). The main thread then
+    resets ``jax._src.distributed.global_state`` by hand and drops every
+    backend + compilation cache: all live ``jax.Array``s and jitted
+    callables die with the old backend, which is why the elastic runtime
+    rebuilds the Trainer and restores from the last committed checkpoint
+    after calling this (verified against jax 0.4.37's State fields)."""
+    from jax._src import distributed as _dist
+    state = _dist.global_state
+    client, service = state.client, state.service
+
+    def _shutdown():
+        for leg in (client, service):
+            if leg is None:
+                continue
+            try:
+                leg.shutdown()
+            except Exception as e:  # dead-peer barrier errors — expected
+                log.info("distributed teardown leg: %s: %s",
+                         type(e).__name__, e)
+
+    t = threading.Thread(target=_shutdown, daemon=True,
+                         name="drt-dist-teardown")
+    t.start()
+    t.join(timeout=timeout_secs)
+    if t.is_alive():
+        log.warning("distributed shutdown still blocked on dead peers "
+                    "after %.1fs — abandoning it (daemon thread)",
+                    timeout_secs)
+    state.client = None
+    state.service = None
+    state.coordinator_address = None
+    state.process_id = 0
+    state.num_processes = 1
+    state.preemption_sync_manager = None
+    import jax.extend.backend
+    jax.extend.backend.clear_backends()
+    jax.clear_caches()
+
+
+def reinitialize(coordinator_address: str, num_processes: int,
+                 process_id: int) -> None:
+    """Re-enter the distributed runtime for a new mesh generation after
+    ``teardown_for_reshard`` — the plain ``initialize`` ladder (same
+    bounded retry; survivors race the chief's fresh bind exactly like a
+    job start). Also the REJOINER's first init: a rejoiner has touched
+    the local backend before this (device-count probes while waiting in
+    the barrier), and ``jax.distributed.initialize`` refuses to run with
+    live backends — drop them first (idempotent after a teardown)."""
+    import jax.extend.backend
+    jax.extend.backend.clear_backends()
+    jax.clear_caches()
+    initialize(coordinator_address=coordinator_address,
+               num_processes=num_processes, process_id=process_id)

@@ -1,0 +1,629 @@
+"""Elastic mesh: shrink/grow the job across MESH GENERATIONS instead of
+requeue-and-restart.
+
+The requeue loop (watchdog -> exit 75 -> supervisor/SLURM restart, PR 4)
+pays a full job restart — scheduler round-trip, cluster re-init, input
+warmup — for every single lost host, at the OLD topology. Horovod
+(arXiv:1802.05799) famously has the same shape: a dead worker kills the
+ring. This module makes peer loss a RESHARD instead:
+
+  generation g (N hosts)
+      │  peer-loss verdict (resilience/watchdog.py) surfaces as a
+      │  gloo/collective error on the survivors' main threads
+      ▼
+  JOIN BARRIER (file-based — no collectives, peers are DEAD):
+      every survivor posts ``round-{g+1}/join-{worker}.json``; once
+      membership is stable for ``settle_secs`` the chief candidate
+      commits ``commit.json`` via exclusive create, pinning the new
+      membership, the epoch-suffixed coordinator
+      (parallel/distributed.elastic_coordinator) and the committed
+      checkpoint step to restore from
+      ▼
+  TEARDOWN + RE-INIT (parallel/distributed.teardown_for_reshard):
+      abandon the dead mesh's blocking shutdown, reset jax's global
+      distributed state, re-``initialize`` over the survivors
+      ▼
+  REBUILD + RESTORE: fresh Trainer over the shrunken mesh (every
+      PartitionSpec / zero1 rule re-elaborates against the new topology),
+      last committed checkpoint restored through the sharded M≠N
+      assemble path (checkpoint/shards.py), global batch rescaled by
+      ``batch_policy`` — generation g+1 (N-1 hosts) resumes stepping.
+
+GROW is the same transition from the other side: the supervisor
+(launch.py --elastic) respawns the dead worker with ``DRT_ELASTIC_REJOIN``;
+the rejoiner posts its join for round g+1 and waits, the live chief
+notices the pending join between steps, coordinates a stop + force-save,
+and the whole fleet (survivors + rejoiner) meets in the same barrier.
+
+Worker identity: the ORIGINAL ``mesh.process_id`` (the launcher slot) is
+the stable ``worker_id`` for the whole process lifetime; each committed
+generation maps its member worker_ids, sorted, onto jax ranks 0..n-1.
+Worker 0 must survive every generation — it hosts the per-generation
+coordinator — so losing it is infeasible and falls back to the exit-75
+requeue contract, as does dropping under ``min_hosts``, a barrier
+timeout, or an exhausted ``max_generations`` budget (docs/resilience.md:
+75 is now the FALLBACK, not the only answer).
+
+The decision logic lives in :class:`CoordinatorSM`, pure of file I/O and
+real time (fake-clock unit tests, tests/test_elastic.py);
+:class:`ElasticRuntime` is the impure driver main.py wires in.
+"""
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import math
+import os
+import shutil
+import time
+from typing import Callable, Optional, Set
+
+from .preemption import RESUMABLE_EXIT_CODE
+
+log = logging.getLogger(__name__)
+
+
+class ReshardRequired(Exception):
+    """Unwind the step loop into the generation loop (main.py): the mesh
+    must transition. ``reason`` is peer_lost | grow."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+        self.detail = detail
+
+
+class ElasticImpossible(Exception):
+    """A reshard cannot happen (chief lost, < min_hosts, barrier timeout,
+    generation budget exhausted, non-elastic layout). Callers fall back
+    to the classic resumable exit (75)."""
+
+    def __init__(self, reason: str, exit_code: int = RESUMABLE_EXIT_CODE):
+        super().__init__(reason)
+        self.reason = reason
+        self.exit_code = exit_code
+
+
+# ---------------------------------------------------------------------------
+# Pure decision logic
+# ---------------------------------------------------------------------------
+
+class CoordinatorSM:
+    """The join-round decision state machine, pure of I/O and real time.
+
+    Drive it with ``step(now, members, commit)`` where ``members`` is the
+    set of worker_ids whose join files exist for the round and ``commit``
+    is the committed record if one exists. Returns one of:
+
+      ``("wait", None)``     — poll again
+      ``("commit", None)``   — THIS worker should attempt the exclusive
+                               commit (it is the chief, membership has
+                               been stable for ``settle_secs`` and is
+                               feasible). The attempt may still lose the
+                               exclusive-create race — feed the resulting
+                               commit back in on the next step.
+      ``("done", record)``   — a commit exists and includes us: adopt it
+      ``("abort", reason)``  — infeasible or timed out: exit-75 fallback
+
+    Commit authority: only worker 0 ever commits — the next generation's
+    coordinator lives on worker 0's host (parallel/distributed.
+    elastic_coordinator), so a membership without it is infeasible and
+    simply never commits; everyone times out into the 75 fallback.
+    Membership changes reset the settle window: several near-simultaneous
+    failures (or a grow racing a late survivor) collapse into ONE
+    transition instead of a cascade.
+    """
+
+    def __init__(self, worker_id: int, min_hosts: int = 2,
+                 settle_secs: float = 2.0, timeout_secs: float = 60.0):
+        self.worker_id = worker_id
+        self.min_hosts = max(1, min_hosts)
+        self.settle_secs = settle_secs
+        self.timeout_secs = timeout_secs
+        self._start: Optional[float] = None
+        self._members: Optional[Set[int]] = None
+        self._stable_since: Optional[float] = None
+
+    def step(self, now: float, members: Set[int],
+             commit: Optional[dict]):
+        if self._start is None:
+            self._start = now
+        if commit is not None:
+            if self.worker_id in commit.get("members", ()):
+                return ("done", commit)
+            # committed without us: we observed the round too late (our
+            # own join raced the settle window) — we are not in the new
+            # mesh, leave through the requeue path
+            return ("abort",
+                    f"generation {commit.get('generation')} committed "
+                    f"without worker {self.worker_id}")
+        if now - self._start >= self.timeout_secs:
+            return ("abort",
+                    f"join barrier timed out after {self.timeout_secs:.0f}s "
+                    f"(members {sorted(members)}, need >= {self.min_hosts} "
+                    "and worker 0)")
+        members = set(members)
+        if members != self._members:
+            self._members = members
+            self._stable_since = now
+            return ("wait", None)
+        if (self.worker_id == 0 and 0 in members
+                and len(members) >= self.min_hosts
+                and self._stable_since is not None
+                and now - self._stable_since >= self.settle_secs):
+            return ("commit", None)
+        return ("wait", None)
+
+
+def rescaled_batch(policy: str, base_global_batch: int,
+                   base_shards: int, new_shards: int):
+    """New generation's global batch under ``batch_policy``.
+
+    ``per_host`` keeps each batch shard's slice constant — the global
+    batch scales with the topology (the LR is deliberately NOT rescaled;
+    docs/resilience.md). ``keep_global`` keeps the original global batch
+    when the new shard count divides it, else falls back to per_host.
+    Returns ``(global_batch, policy_applied)``."""
+    per_shard = max(1, base_global_batch // max(1, base_shards))
+    if policy == "keep_global":
+        if base_global_batch % max(1, new_shards) == 0:
+            return base_global_batch, "keep_global"
+        log.warning(
+            "elastic batch_policy=keep_global: global batch %d not "
+            "divisible by %d batch shards — falling back to per_host",
+            base_global_batch, new_shards)
+    return per_shard * new_shards, "per_host"
+
+
+# ---------------------------------------------------------------------------
+# File driver
+# ---------------------------------------------------------------------------
+
+def _write_json_atomic(path: str, doc: dict) -> None:
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.flush()
+        # elastic barrier control plane, not checkpoint payload: the step
+        # loop is already stopped for the reshard when these are written
+        os.fsync(f.fileno())  # shardcheck: ok(ckpt-io-thread)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class ElasticState:
+    """The shared-directory side of the barrier: one ``round-{g}`` dir per
+    transition holding ``join-{worker}.json`` files and the exclusive
+    ``commit.json``, plus the top-level ``generation.json`` describing
+    the LIVE generation (what a rejoining peer reads first)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _round_dir(self, gen: int) -> str:
+        return os.path.join(self.directory, f"round-{gen}")
+
+    def post_join(self, gen: int, worker_id: int, info: dict) -> None:
+        d = self._round_dir(gen)
+        os.makedirs(d, exist_ok=True)
+        _write_json_atomic(os.path.join(d, f"join-{worker_id}.json"),
+                           {"worker_id": worker_id, **info})
+
+    def members(self, gen: int) -> Set[int]:
+        d = self._round_dir(gen)
+        out: Set[int] = set()
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith("join-") and name.endswith(".json"):
+                try:
+                    out.add(int(name[len("join-"):-len(".json")]))
+                except ValueError:
+                    pass
+        return out
+
+    def read_commit(self, gen: int) -> Optional[dict]:
+        return _read_json(os.path.join(self._round_dir(gen), "commit.json"))
+
+    def try_commit(self, gen: int, record: dict) -> dict:
+        """Exclusive-create commit: first writer wins, everyone honors
+        the file's content (including a winner that raced us)."""
+        d = self._round_dir(gen)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "commit.json")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record, f, sort_keys=True)
+            f.flush()
+            # reshard-barrier commit record (control plane; loop stopped)
+            os.fsync(f.fileno())  # shardcheck: ok(ckpt-io-thread)
+        try:
+            # hard link = exclusive create with full content already in
+            # place (no torn reads through the 'x' + write window)
+            os.link(tmp, path)
+        except FileExistsError:
+            pass
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        committed = _read_json(path)
+        return committed if committed is not None else record
+
+    def read_generation(self) -> Optional[dict]:
+        return _read_json(os.path.join(self.directory, "generation.json"))
+
+    def write_generation(self, record: dict) -> None:
+        _write_json_atomic(os.path.join(self.directory, "generation.json"),
+                           record)
+
+    def cleanup_rounds(self, before_gen: int) -> None:
+        """Drop round dirs older than ``before_gen`` (their commits are
+        history once a newer generation is LIVE in generation.json)."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("round-"):
+                continue
+            try:
+                g = int(name[len("round-"):])
+            except ValueError:
+                continue
+            if g < before_gen:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Runtime driver
+# ---------------------------------------------------------------------------
+
+class ElasticRuntime:
+    """main.py's handle on the elastic machinery for ONE process lifetime.
+
+    Holds the stable ``worker_id`` (the launcher's original
+    ``mesh.process_id``), the current generation + membership, and drives
+    transitions: ``transition()`` runs the file barrier and returns the
+    committed record; ``derive_config()`` maps a record onto a concrete
+    per-generation config; ``mark_live()`` publishes generation.json +
+    the mesh_generation metrics row once the new mesh steps.
+
+    ``watchdog_defer`` is the escalation fork resilience/watchdog.py
+    calls before a peer-lost hard exit: True while this process can (or
+    is busy trying to) reshard instead of dying.
+    """
+
+    def __init__(self, cfg, worker_id=None, num_processes=None,
+                 clock=time.monotonic, wall_clock=time.time):
+        self.cfg = cfg
+        self.ecfg = cfg.resilience.elastic
+        # identity: explicit override for launched runs where the config
+        # carries the slot (rejoin), jax's live rank otherwise (SLURM
+        # autodetect leaves cfg.mesh.process_id at its default)
+        self.worker_id = int(cfg.mesh.process_id if worker_id is None
+                             else worker_id)
+        self.base_processes = int(cfg.mesh.num_processes
+                                  if num_processes is None
+                                  else num_processes)
+        self.base_coordinator = cfg.mesh.coordinator_address or ""
+        self.base_global_batch = int(cfg.train.batch_size)
+        self._clock = clock
+        self._wall = wall_clock
+        state_dir = self.ecfg.state_dir or os.path.join(
+            cfg.log_root, "elastic")
+        self.state = ElasticState(state_dir) if self.enabled else None
+        self.generation = 0
+        self.members: Set[int] = set(range(max(1, self.base_processes)))
+        self.in_transition = False
+        self._defer_since: Optional[float] = None
+        self._last_join_poll = 0.0
+        self._transitions = 0
+
+    # -- predicates ----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return (str(self.ecfg.enabled).lower() in ("on", "true", "1")
+                and self.base_processes > 1)
+
+    def _layout_elastic(self) -> Optional[str]:
+        """None when the mesh layout can reshard, else why not: the data
+        axis must be the wildcard (-1) so it re-resolves over any device
+        count, and the fixed axes' product must divide the per-host
+        device count (each host holds whole non-data blocks — the
+        contiguous-batch-slice requirement, parallel/mesh.py)."""
+        m = self.cfg.mesh
+        if m.data != -1:
+            return (f"mesh.data={m.data} is pinned — elastic needs the "
+                    "data axis as the -1 wildcard")
+        import jax
+        fixed = math.prod(1 if s in (0, -1) else s for s in
+                          (m.pipeline, m.fsdp, m.expert, m.sequence,
+                           m.tensor))
+        local = jax.local_device_count()
+        if fixed > local or local % fixed != 0:
+            return (f"fixed mesh axes product {fixed} does not divide the "
+                    f"per-host device count {local}")
+        return None
+
+    def can_reshard(self) -> bool:
+        """The watchdog/teardown fork's question: is attempting a shrink
+        transition worth deferring the exit-75 for?"""
+        if not self.enabled or self.state is None:
+            return False
+        if not self.base_coordinator:
+            # SLURM/TPU-pod autodetected worlds carry no explicit
+            # coordinator_address to derive epoch-suffixed ports from
+            log.warning("elastic: no mesh.coordinator_address to derive "
+                        "per-generation coordinators from — falling back "
+                        "to exit 75")
+            return False
+        if self.ecfg.max_generations and \
+                self._transitions >= self.ecfg.max_generations:
+            log.warning("elastic: generation budget exhausted (%d) — "
+                        "falling back to exit 75", self.ecfg.max_generations)
+            return False
+        why = self._layout_elastic()
+        if why is not None:
+            log.warning("elastic: layout not reshardable (%s) — falling "
+                        "back to exit 75", why)
+            return False
+        return True
+
+    def watchdog_defer(self) -> bool:
+        """Escalation fork (resilience/watchdog.py _maybe_exit): defer a
+        peer-lost/collective-hang hard exit while a reshard is
+        possible/in progress, bounded by ``reshard_timeout_secs`` from
+        the FIRST defer.
+
+        The commit-without-us break covers the non-adjacent survivor: a
+        peer's death only RAISES in the collectives of its gloo ring
+        neighbours — a survivor two hops away stays wedged with no
+        exception and can never reach the barrier on its main thread.
+        Once the next round commits without us, deferring is pointless:
+        return False so the watchdog exits 75 and the supervisor
+        respawns us as a rejoiner into the round after."""
+        if not self.can_reshard():
+            return False
+        now = self._clock()
+        if self._defer_since is None:
+            self._defer_since = now
+            log.info("elastic: deferring watchdog peer-lost exit — will "
+                     "reshard instead (bound %.0fs)",
+                     self.ecfg.reshard_timeout_secs)
+        if not self.in_transition and self.state is not None:
+            commit = self.state.read_commit(self.generation + 1)
+            if commit is not None and \
+                    self.worker_id not in commit.get("members", ()):
+                log.warning(
+                    "elastic: round %d committed without worker %d while "
+                    "the main thread is wedged — ending the defer; the "
+                    "75 exit lets the supervisor respawn us as a rejoiner",
+                    self.generation + 1, self.worker_id)
+                return False
+        return now - self._defer_since < self.ecfg.reshard_timeout_secs
+
+    def pending_join(self, force: bool = False) -> bool:
+        """Throttled check (the chief's between-steps grow poll): has a
+        replacement/new worker posted a join for the NEXT round?
+        ``force`` skips the throttle — the post-loop grow fork must read
+        the CURRENT state on every process, not a cached negative."""
+        if not self.enabled or self.state is None:
+            return False
+        now = self._clock()
+        if not force and \
+                now - self._last_join_poll < max(0.05, self.ecfg.poll_secs):
+            return False
+        self._last_join_poll = now
+        pending = self.state.members(self.generation + 1) - self.members
+        return bool(pending)
+
+    # -- the transition ------------------------------------------------------
+    def _build_record(self, next_gen: int, members: Set[int], reason: str,
+                      restore_step: Optional[int]) -> dict:
+        from ..parallel.distributed import elastic_coordinator
+        import jax
+        # batch shards are DEVICES along the batch axes, not hosts —
+        # keep_global's divisibility check must see the real shard count
+        # (per-host rescale is invariant to the per-host device factor)
+        ldc = max(1, jax.local_device_count())
+        gbs, applied = rescaled_batch(
+            self.ecfg.batch_policy, self.base_global_batch,
+            self.base_processes * ldc, len(members) * ldc)
+        return {
+            "generation": next_gen,
+            "members": sorted(int(w) for w in members),
+            "coordinator": elastic_coordinator(
+                self.base_coordinator, next_gen, self.ecfg.port_stride),
+            "restore_step": -1 if restore_step is None else int(restore_step),
+            "global_batch": gbs,
+            "batch_policy": applied,
+            "reason": reason,
+            "time": self._wall(),
+        }
+
+    def transition(self, reason: str,
+                   restore_step_fn: Callable[[], Optional[int]],
+                   timeout_secs: Optional[float] = None) -> dict:
+        """Run the join barrier for round ``generation+1`` and adopt the
+        committed record. Raises :class:`ElasticImpossible` on abort.
+        ``restore_step_fn`` is called by the committing chief to pin the
+        checkpoint step the new generation restores from (survivors and
+        rejoiners then restore that EXACT step — no post-teardown
+        agreement broadcast needed)."""
+        if not self.enabled or self.state is None:
+            raise ElasticImpossible("elastic disabled")
+        if not self.can_reshard():
+            raise ElasticImpossible("reshard infeasible "
+                                    "(budget/layout — see log)")
+        ecfg = self.ecfg
+        next_gen = self.generation + 1
+        timeout = ecfg.barrier_timeout_secs if timeout_secs is None \
+            else timeout_secs
+        self.in_transition = True
+        sm = CoordinatorSM(self.worker_id, min_hosts=ecfg.min_hosts,
+                           settle_secs=ecfg.settle_secs,
+                           timeout_secs=timeout)
+        self.state.post_join(next_gen, self.worker_id, {
+            "reason": reason, "from_generation": self.generation,
+            "time": self._wall()})
+        log.info("elastic: joined round %d (reason %s) as worker %d",
+                 next_gen, reason, self.worker_id)
+        while True:
+            action, payload = sm.step(
+                self._clock(), self.state.members(next_gen),
+                self.state.read_commit(next_gen))
+            if action == "done":
+                record = payload
+                break
+            if action == "abort":
+                self.in_transition = False
+                self._defer_since = None
+                raise ElasticImpossible(payload)
+            if action == "commit":
+                record = self._build_record(
+                    next_gen, self.state.members(next_gen), reason,
+                    restore_step_fn())
+                committed = self.state.try_commit(next_gen, record)
+                log.info("elastic: committed round %d: members %s "
+                         "restore_step %s", next_gen,
+                         committed.get("members"),
+                         committed.get("restore_step"))
+                continue  # adopt through the normal read path
+            time.sleep(max(0.05, ecfg.poll_secs))
+        self.generation = int(record["generation"])
+        self.members = set(record["members"])
+        self._transitions += 1
+        log.info("elastic: adopted generation %d: members %s (rank %d), "
+                 "coordinator %s, restore step %s, global batch %s",
+                 self.generation, record["members"], self.rank(record),
+                 record["coordinator"], record["restore_step"],
+                 record["global_batch"])
+        return record
+
+    def rejoin(self, restore_step_fn: Optional[
+            Callable[[], Optional[int]]] = None) -> dict:
+        """A respawned/replacement worker's entry (DRT_ELASTIC_REJOIN):
+        read the live generation, post a join for the next round, wait
+        for the fleet to meet us there. Returns the committed record.
+        ``restore_step_fn`` matters when the WHOLE fleet died and every
+        worker comes back as a rejoiner: the rejoined chief is then the
+        round's committer and must still pin the newest committed
+        checkpoint, or the new generation restarts from step 0."""
+        if not self.enabled or self.state is None:
+            raise ElasticImpossible("elastic disabled")
+        if restore_step_fn is None:
+            restore_step_fn = lambda: None  # noqa: E731
+        deadline = self._clock() + self.ecfg.rejoin_timeout_secs
+        live = self.state.read_generation()
+        if live is not None:
+            self.generation = int(live.get("generation", 0))
+            self.members = set(live.get("members", ()))
+        log.info("elastic: rejoin as worker %d — live generation %d, "
+                 "posting join for round %d", self.worker_id,
+                 self.generation, self.generation + 1)
+        while True:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                raise ElasticImpossible(
+                    f"rejoin timed out after "
+                    f"{self.ecfg.rejoin_timeout_secs:.0f}s")
+            try:
+                return self.transition(
+                    "rejoin", restore_step_fn,
+                    timeout_secs=min(remaining,
+                                     self.ecfg.barrier_timeout_secs))
+            except ElasticImpossible as e:
+                # the live fleet may have advanced a generation while we
+                # waited (e.g. another peer died, or the survivors' shrink
+                # round committed before our join landed): re-read and
+                # retry against the new round until the rejoin deadline
+                live = self.state.read_generation()
+                new_gen = int(live.get("generation", 0)) if live else None
+                if new_gen is None or new_gen <= self.generation:
+                    # generation.json lags the commit (the fleet is still
+                    # restoring) — the committed round itself names the
+                    # generation to chase
+                    c = self.state.read_commit(self.generation + 1)
+                    if c is not None and \
+                            self.worker_id not in c.get("members", ()):
+                        new_gen = int(c.get("generation",
+                                            self.generation + 1))
+                        live = c
+                if new_gen is not None and new_gen > self.generation:
+                    self.generation = new_gen
+                    self.members = set(live.get("members", ()))
+                    log.info("elastic: rejoin retargeting round %d (%s)",
+                             self.generation + 1, e.reason)
+                    continue
+                raise
+
+    # -- post-transition helpers --------------------------------------------
+    def rank(self, record: dict) -> int:
+        members = sorted(record["members"])
+        return members.index(self.worker_id)
+
+    def derive_config(self, record: dict):
+        """The committed record mapped onto a concrete config for this
+        generation: new world size/rank/coordinator + rescaled batch.
+        Everything else (model, data, checkpoint dir, log_root) carries
+        over — the new Trainer re-elaborates every sharding rule from
+        this config against the new device count."""
+        cfg2 = copy.deepcopy(self.cfg)
+        cfg2.mesh.num_processes = len(record["members"])
+        cfg2.mesh.process_id = self.rank(record)
+        cfg2.mesh.coordinator_address = record["coordinator"]
+        cfg2.train.batch_size = int(record["global_batch"])
+        return cfg2
+
+    def mark_live(self, record: Optional[dict], step: int,
+                  writer=None) -> None:
+        """The generation is stepping: chief publishes generation.json
+        (what rejoiners bootstrap from), tombstones departed heartbeat
+        ranks, drops stale round dirs, and emits the mesh_generation
+        metrics row. Safe to call every generation including 0."""
+        self.in_transition = False
+        self._defer_since = None
+        if not self.enabled or self.state is None:
+            return
+        import jax
+        if jax.process_index() != 0:
+            return
+        doc = {
+            "generation": self.generation,
+            "members": sorted(self.members),
+            "coordinator": (record or {}).get(
+                "coordinator", self.base_coordinator),
+            "restore_step": (record or {}).get("restore_step", -1),
+            "global_batch": (record or {}).get(
+                "global_batch", self.base_global_batch),
+            "time": self._wall(),
+        }
+        self.state.write_generation(doc)
+        self.state.cleanup_rounds(self.generation)
+        from .heartbeat import tombstone_departed
+        wd_cfg = self.cfg.resilience.watchdog
+        hb_dir = wd_cfg.heartbeat_dir or os.path.join(
+            self.cfg.log_root, "heartbeats")
+        tombstone_departed(hb_dir, range(jax.process_count()))
+        if writer is not None:
+            writer.write_event("mesh_generation", {
+                "generation": self.generation,
+                "hosts": jax.process_count(),
+                "devices": jax.device_count(),
+                "step": int(step),
+                "coordinator": doc["coordinator"],
+            })

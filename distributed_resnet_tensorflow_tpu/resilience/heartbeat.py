@@ -27,6 +27,7 @@ detection/teardown story and the metrics.jsonl schemas.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import json
 import logging
@@ -44,11 +45,22 @@ log = logging.getLogger(__name__)
 PHASE_DONE = "done"
 PHASE_PREEMPTED = "preempted"
 PHASE_FAILED = "failed"
-DEPARTED_PHASES = (PHASE_DONE, PHASE_PREEMPTED, PHASE_FAILED)
+#: this process left its CURRENT mesh generation to reshard into the next
+#: one (resilience/elastic.py) — a deliberate departure like done/preempted
+#: (the next generation's fresh transport epoch makes the beat invisible
+#: to the new watchdog either way)
+PHASE_RESHARD = "reshard"
+DEPARTED_PHASES = (PHASE_DONE, PHASE_PREEMPTED, PHASE_FAILED, PHASE_RESHARD)
+
+#: the train loop's host-side input fetch (``data_fetch`` below): a hang
+#: HERE is self-attributable — OUR input pipeline stalled, not a peer's
+#: collective — which is what lets the watchdog's elastic fork exit the
+#: culprit promptly while the survivors defer and reshard around it
+PHASE_DATA = "data"
 
 #: phases in which a stalled ``progress`` counter indicates a hang (init /
 #: compile / save are legitimately long and un-ticked)
-MONITORED_PHASES = ("train", "eval")
+MONITORED_PHASES = ("train", "eval", PHASE_DATA)
 
 
 @dataclasses.dataclass
@@ -66,9 +78,11 @@ class Beat:
     step: int          # last completed optimizer step
     progress: int      # steps + eval batches; the liveness counter
     phase: str         # init | train | eval_init | eval | save | poll |
-                       # done | preempted | failed (only train/eval are
-                       # hang-monitored, MONITORED_PHASES)
+                       # done | preempted | failed | reshard (only
+                       # train/eval are hang-monitored, MONITORED_PHASES)
     wall_time: float
+    generation: int = 0  # elastic mesh generation this beat was published
+                         # in (resilience/elastic.py; 0 = non-elastic run)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -170,6 +184,45 @@ class FileBeatTransport(BeatTransport):
         return out
 
 
+def tombstone_departed(directory: str, keep_process_ids) -> int:
+    """Remove beat files (live AND final sidecars) of processes that are
+    no longer part of the run — deliberately drained, replaced, or left
+    behind by a smaller mesh generation (resilience/elastic.py calls this
+    when a generation goes live, with the new membership's ranks).
+
+    Without tombstoning, only the transport's epoch filter hides a
+    departed host's last beat — ``main.py monitor`` (no epoch) would show
+    it as a stale host forever, and a future transport without the filter
+    would re-flag it. Removal races with concurrent readers are benign:
+    ``peers`` already skips unreadable files. Returns the number of files
+    removed; unknown/foreign file names are left alone."""
+    keep = {int(p) for p in keep_process_ids}
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    for name in names:
+        if not (name.startswith("proc") and name.endswith(".json")):
+            continue
+        stem = name[len("proc"):].split(".", 1)[0]
+        try:
+            pid = int(stem)
+        except ValueError:
+            continue
+        if pid in keep:
+            continue
+        try:
+            os.remove(os.path.join(directory, name))
+            removed += 1
+        except OSError:
+            pass
+    if removed:
+        log.info("heartbeat tombstone: removed %d beat file(s) of "
+                 "departed process(es) not in %s", removed, sorted(keep))
+    return removed
+
+
 class HeartbeatPublisher:
     """Daemon publishing thread + the hot-path state it reports.
 
@@ -191,9 +244,11 @@ class HeartbeatPublisher:
 
     def __init__(self, transport: BeatTransport, process_id: int,
                  interval_secs: float = 1.0,
-                 clock=time.monotonic, wall_clock=time.time):
+                 clock=time.monotonic, wall_clock=time.time,
+                 generation: int = 0):
         self.transport = transport
         self.process_id = process_id
+        self.generation = generation
         self.interval_secs = max(0.05, interval_secs)
         self._clock = clock
         self._wall = wall_clock
@@ -272,6 +327,31 @@ class HeartbeatPublisher:
             self._phase = phase
             self._interlude = True
 
+    @contextlib.contextmanager
+    def data_fetch(self):
+        """Mark the train loop's blocking host-side input draw: phase
+        'train' → 'data' for the duration. A hang verdict then reads the
+        culprit off the phase — 'data' means OUR input pipeline stalled
+        (exit promptly so an elastic fleet can shrink around us), 'train'
+        means we are wedged in a collective (plausibly a peer's fault —
+        the watchdog's elastic fork defers that exit; resilience/
+        watchdog.py _maybe_exit). Only flips when the current phase IS
+        'train': the first fetch lands in the unmonitored 'init' phase
+        (XLA compile) and eval owns its own phases. Unlike set_phase this
+        is NOT an interlude — a fetch precedes every step, and marking it
+        would starve the per-step-time EWMA."""
+        with self._lock:
+            flip = self._phase == "train"
+            if flip:
+                self._phase = PHASE_DATA
+        try:
+            yield
+        finally:
+            if flip:
+                with self._lock:
+                    if self._phase == PHASE_DATA:
+                        self._phase = "train"
+
     def snapshot(self) -> dict:
         """Local state for the watchdog (no I/O)."""
         with self._lock:
@@ -297,7 +377,8 @@ class HeartbeatPublisher:
             return Beat(process_id=self.process_id, pid=self._pid,
                         host=self._host, seq=self._seq, step=self._step,
                         progress=self._progress, phase=self._phase,
-                        wall_time=self._wall())
+                        wall_time=self._wall(),
+                        generation=self.generation)
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_secs):

@@ -159,6 +159,19 @@ class PreemptionListener:
             self._reason = reason
         self._event.set()
 
+    def reset(self) -> None:
+        """Clear a consumed stop request so the loop can run again — the
+        elastic generation transition (resilience/elastic.py): the
+        watchdog's peer-lost ``request_stop`` (or the chief's "reshard"
+        grow request) belongs to the PREVIOUS mesh generation; without a
+        reset the new generation's stop poll would fire on its first
+        step. Signal state is deliberately NOT cleared: a real SIGTERM
+        must keep stopping the run across generations."""
+        if self._reason is not None and \
+                not self._reason.startswith("signal "):
+            self._reason = None
+            self._event.clear()
+
     # -- polling API (train-loop hot path: one Event.is_set + a clock read) -
     def should_stop(self) -> bool:
         if self._event.is_set():

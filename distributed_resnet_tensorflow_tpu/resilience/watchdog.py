@@ -129,6 +129,17 @@ class Watchdog:
         self._anomaly_seen_seq = 0
         self._anomaly_active = False
         self._anomaly_last_fire = float("-inf")
+        # elastic escalation fork (resilience/elastic.py): hook() -> bool,
+        # True while a peer-lost (or collective-hang, see _maybe_exit)
+        # hard exit must be DEFERRED because the main thread can reshard
+        # into a smaller mesh generation instead of dying 75. The hook
+        # owns its own time bound (reshard_timeout_secs) so a wedged
+        # transition still exits.
+        self._elastic_defer: Optional[Callable[[], bool]] = None
+
+    def set_elastic(self, hook: Optional[Callable[[], bool]]) -> None:
+        """Install the elastic runtime's defer hook (main.py wiring)."""
+        self._elastic_defer = hook
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Watchdog":
@@ -406,6 +417,26 @@ class Watchdog:
                 now - fired_at < max(self.cfg.grace_secs,
                                      self.cfg.min_step_timeout_secs):
             return
+        # elastic fork: a lost peer is not a death sentence when the main
+        # thread can reshard — hold the 75 back while the hook says the
+        # transition is possible/in progress (it returns False once its
+        # reshard_timeout_secs bound expires, restoring the requeue path).
+        # A HANG verdict defers too, but only while our own phase is
+        # 'train': blocked inside a collective means the stall is
+        # plausibly a PEER's (the culprit's own verdict reads phase
+        # 'data'/'eval' and exits promptly; once it dies, our wedged
+        # collective raises and failure_verdict attributes the peer loss
+        # on the main thread). A hang in the 'data' phase is OUR input
+        # pipeline — exit now so an elastic fleet can shrink around us.
+        deferrable = fresh[0] == "peer_lost" or (
+            fresh[0] == "hang"
+            and self.publisher.snapshot()["phase"] == "train")
+        if deferrable and self._elastic_defer is not None:
+            try:
+                if self._elastic_defer():
+                    return
+            except Exception:  # never let the hook break the escalation
+                log.exception("watchdog: elastic defer hook failed")
         self.exit_now(*fresh)
 
     def exit_now(self, kind: str, code: int, detail: str) -> None:
@@ -443,8 +474,12 @@ class Watchdog:
         this polls up to ``wait_secs`` (default: peer_timeout + 2 beat
         intervals) for the beats to confirm. Returns (kind, exit_code,
         detail) or None (no peer evidence: the error is OURS)."""
-        if self._fired is not None:
+        if self._fired is not None and self._fired[0] != "hang":
             return self._fired[:3]
+        # a pending HANG verdict does not bind this path: the collective
+        # raising IS new evidence that the stall was a peer's death (the
+        # daemon's elastic fork is deferring that 75 right now) — fall
+        # through to the beat poll so the verdict names the peer
         if wait_secs is None:
             wait_secs = self.cfg.peer_timeout_secs + 2 * self.cfg.interval_secs
         deadline = self._clock() + wait_secs

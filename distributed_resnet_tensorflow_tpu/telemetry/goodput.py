@@ -14,6 +14,8 @@ measure ANY of it (stdout logs + TensorBoard scalars only, SURVEY.md
   * ``restart``     — NaN-rollback restores (resilience/sentinel.py),
   * ``stall``       — watchdog-attributed dead time (hang verdicts,
     resilience/watchdog.py),
+  * ``reshard``     — elastic mesh-generation transitions: barrier +
+    teardown + re-init + restore + rebuild (resilience/elastic.py),
   * ``compute``     — everything else: the remainder of the wall interval.
     Remainder-as-compute is the honest choice under async dispatch — the
     loop thread does not block per step, so its non-waiting wall time IS
@@ -36,7 +38,7 @@ from typing import Dict, Optional
 #: the classification buckets, in display order. "compute" is always the
 #: interval remainder; the others are measured from categorized spans.
 CATEGORIES = ("compute", "input_wait", "checkpoint", "eval", "stall",
-              "restart")
+              "restart", "reshard")
 
 #: the buckets spans may charge (everything but the remainder)
 MEASURED_CATEGORIES = CATEGORIES[1:]

@@ -172,6 +172,22 @@ def summarize_stream(stream_dir: str, now: Optional[float] = None) -> dict:
     cr = _last(rows, "corrupt_record")
     if cr is not None:
         out["corrupt_records"] = cr.get("count")
+    mg = _last(rows, "mesh_generation")
+    if mg is not None:
+        out["mesh_generation"] = {
+            "generation": mg.get("generation"),
+            "hosts": mg.get("hosts"),
+            "devices": mg.get("devices"),
+            "step": mg.get("step")}
+    rs = _last(rows, "reshard")
+    if rs is not None:
+        out["reshard"] = {
+            "generation": rs.get("generation"),
+            "reason": rs.get("reason"),
+            "old_hosts": rs.get("old_hosts"),
+            "new_hosts": rs.get("new_hosts"),
+            "restore_step": rs.get("restore_step"),
+            "age_secs": round(now - rs.get("time", now), 1)}
     mem = _last(rows, "memory")
     if mem is not None:
         out["memory"] = _memory_summary(mem)
@@ -272,9 +288,22 @@ def aggregate(root: str, now: Optional[float] = None,
             out["host_step_skew"] = max(steps) - min(steps)
         stale = [pid for pid, b in beats.items()
                  if b.get("age_secs", 0) > 60
-                 and b.get("phase") not in ("done", "preempted", "failed")]
+                 and b.get("phase") not in ("done", "preempted", "failed",
+                                            "reshard")]
         if stale:
             out["stale_hosts"] = stale
+        # elastic fleet shape: the beats carry the mesh generation each
+        # process is currently stepping in (resilience/heartbeat.py);
+        # the live count excludes departed phases
+        gens = [b.get("generation") for b in beats.values()
+                if b.get("generation") is not None]
+        if gens:
+            out["mesh_generation"] = max(gens)
+            out["live_hosts"] = sum(
+                1 for b in beats.values()
+                if b.get("generation") == out["mesh_generation"]
+                and b.get("phase") not in ("done", "preempted", "failed",
+                                           "reshard"))
     ckpt = _checkpoint_step(root)
     if ckpt is not None:
         out["last_committed_step"] = ckpt
@@ -337,6 +366,16 @@ def aggregate(root: str, now: Optional[float] = None,
         if "goodput" in s:
             out.setdefault("goodput", s["goodput"])
             break
+    # newest reshard / mesh_generation event rows across streams (the
+    # chief emits them; a fresh generation may write to a new stream)
+    for key, field in (("last_reshard", "reshard"),
+                       ("mesh_generation_event", "mesh_generation")):
+        rows = [s[field] for s in streams.values() if field in s]
+        if rows:
+            out[key] = max(rows, key=lambda r: r.get("generation") or 0)
+            if "mesh_generation" not in out and \
+                    out[key].get("generation") is not None:
+                out["mesh_generation"] = out[key]["generation"]
     return out
 
 
@@ -352,7 +391,19 @@ def render(agg: dict) -> str:
         lines.append("  goodput: " + "  ".join(
             f"{c} {gp.get(c, 0):.1f}%" for c in
             ("compute", "input_wait", "checkpoint", "eval", "stall",
-             "restart") if gp.get(c)))
+             "restart", "reshard") if gp.get(c)))
+    if "mesh_generation" in agg:
+        bits = [f"  elastic: generation {agg['mesh_generation']}"]
+        if "live_hosts" in agg:
+            bits.append(f"{agg['live_hosts']} live host(s)")
+        rs = agg.get("last_reshard")
+        if rs:
+            bits.append(
+                f"last reshard {rs.get('reason')} "
+                f"{rs.get('old_hosts')}->{rs.get('new_hosts')} hosts "
+                f"(restore step {rs.get('restore_step')}, "
+                f"{rs.get('age_secs', '?')}s ago)")
+        lines.append(", ".join(bits))
     if "last_committed_step" in agg:
         lines.append(f"  checkpoint: step {agg['last_committed_step']} "
                      "committed")

@@ -49,7 +49,9 @@ log = logging.getLogger(__name__)
 
 #: bump when the trace.json event shape changes (consumers key on it via
 #: the ``trace_dump`` metrics row and the file's otherData block)
-SPAN_SCHEMA_VERSION = 6  # 6: + comm.probe; comm.bucket / zero1.gather
+SPAN_SCHEMA_VERSION = 7  # 7: + reshard.* family (elastic mesh
+#                              shrink/grow transition, round 16)
+#                          6: + comm.probe; comm.bucket / zero1.gather
 #                              gain a bucket-index arg so the merged
 #                              timeline / comm report can join spans to
 #                              the plan (performance observability,
@@ -127,6 +129,24 @@ SPAN_CATALOG = {
     "serve.variant_build": "one serving precision variant's weight copy "
                            "cast from the f32 masters (startup and every "
                            "hot swap; docs/precision.md)",
+    # elastic mesh generation transition (resilience/elastic.py;
+    # goodput: reshard for every leg — the whole transition is
+    # non-compute wall time)
+    "reshard.barrier": "file-based join barrier: post membership, wait "
+                       "for the settle window + the chief candidate's "
+                       "commit record (no collectives — peers may be "
+                       "dead)",
+    "reshard.teardown": "dead-mesh teardown: abandon the blocking "
+                        "distributed-client shutdown in a daemon thread, "
+                        "reset jax's process-global distributed state, "
+                        "clear backends + caches",
+    "reshard.init": "jax.distributed re-initialize over the survivors at "
+                    "the new generation's epoch-suffixed coordinator",
+    "reshard.restore": "last committed checkpoint restored into the new "
+                       "topology (sharded M≠N assemble path when the "
+                       "layout is sharded)",
+    "reshard.rebuild": "Trainer/mesh/sharding re-elaboration + input "
+                       "source rebuild for the new generation",
 }
 
 # unknown span names already warned about (warn once, like write_event)

@@ -20,6 +20,7 @@ stands in for very large global batches on small meshes.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 from functools import partial
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
@@ -1096,6 +1097,12 @@ class Trainer:
                     data_iter,
                     device_prefetch(iter(data_iter), put_one, depth=depth))
             dev_iter = self._dev_prefetch[1]
+            # heartbeat phase flip around the blocking draw: a hang during
+            # the fetch is OUR input pipeline, not a peer's collective —
+            # the watchdog attributes by phase (resilience/heartbeat.py
+            # data_fetch)
+            fetch_cm = self.heartbeat.data_fetch \
+                if self.heartbeat is not None else contextlib.nullcontext
             batch = None
             batch_uses = 0
             for step in range(start_step, num_steps):
@@ -1104,7 +1111,8 @@ class Trainer:
                         # flight-recorder + goodput: time blocked on input
                         # (telemetry/; the span is ~2 clock reads when
                         # enabled, a shared no-op otherwise)
-                        with span("input.wait", category="input_wait"):
+                        with span("input.wait", category="input_wait"), \
+                                fetch_cm():
                             batch = next(dev_iter)
                     except StopIteration:
                         # finite stream exhausted: end training cleanly,
@@ -1141,6 +1149,8 @@ class Trainer:
                 None]
         entry = self._multi_prefetch
         stacked_iter = entry[1]
+        fetch_cm = self.heartbeat.data_fetch \
+            if self.heartbeat is not None else contextlib.nullcontext
 
         def single_fn():
             return self.jitted_index_step() if use_idx \
@@ -1181,7 +1191,7 @@ class Trainer:
             if stop_fn is not None and stop_fn():
                 return self.state, metrics
             try:
-                with span("input.wait", category="input_wait"):
+                with span("input.wait", category="input_wait"), fetch_cm():
                     stacked = next(stacked_iter)
             except StopIteration:
                 return self.state, metrics
@@ -1202,7 +1212,8 @@ class Trainer:
         # thread iterates it concurrently.
         if step < num_steps:
             try:
-                stacked = next(stacked_iter)
+                with fetch_cm():
+                    stacked = next(stacked_iter)
             except StopIteration:
                 return self.state, metrics
             take = num_steps - step

@@ -375,6 +375,63 @@ class WatchdogConfig:
 
 
 @dataclass
+class ElasticConfig:
+    """Elastic mesh (resilience/elastic.py; docs/resilience.md): on a
+    peer-loss verdict the survivors reshard into a smaller mesh
+    GENERATION and keep training from the last committed checkpoint
+    instead of exiting 75 for a full SLURM requeue; a respawned/replaced
+    peer grows the next generation back. 75 remains the FALLBACK when a
+    reshard is impossible (chief lost, fewer than min_hosts survivors,
+    barrier timeout, max_generations exhausted)."""
+
+    # off by default: the exit-75 requeue contract stays the baseline
+    # behavior; "on" requires >1 process and the file watchdog transport
+    enabled: str = "off"              # on | off
+    # what happens to the global batch when the host count changes:
+    #   per_host    — keep each host's per-host batch; the global batch
+    #                 scales with the generation's host count (LR is NOT
+    #                 rescaled — deliberate, documented)
+    #   keep_global — keep the ORIGINAL global batch when it divides the
+    #                 new batch-shard count, else fall back to per_host
+    #                 with a loud warning
+    batch_policy: str = "per_host"    # per_host | keep_global
+    # below this many survivors, give up and exit 75 (requeue)
+    min_hosts: int = 2
+    # membership must be stable this long before the chief commits a
+    # generation (absorbs several near-simultaneous failures into ONE
+    # reshard instead of a cascade)
+    settle_secs: float = 2.0
+    # give up on the join barrier (→ exit 75) after this long
+    barrier_timeout_secs: float = 60.0
+    # bound on one whole transition (barrier + teardown + re-init +
+    # restore + rebuild) — ALSO how long the watchdog defers its
+    # peer-lost hard-exit while this process can still reshard
+    # (resilience/watchdog.py escalation fork)
+    reshard_timeout_secs: float = 180.0
+    # how long a respawned/replacement peer waits for the live fleet to
+    # notice its join and commit the grown generation before giving up
+    # with exit 75 (the fleet only polls between steps and may be mid-
+    # save — patient by default)
+    rejoin_timeout_secs: float = 600.0
+    # how long the abandoned distributed-client shutdown thread gets
+    # before the survivor proceeds without it
+    teardown_timeout_secs: float = 5.0
+    # join-file poll cadence inside the barrier; also the throttle for the
+    # chief's between-steps pending-join (grow) check
+    poll_secs: float = 0.5
+    # generation g re-initializes at coordinator port base + g * stride
+    # (parallel/distributed.py elastic_coordinator)
+    port_stride: int = 7
+    # hard cap on transitions in one process lifetime (0 = unlimited);
+    # a flapping host cannot thrash the job forever — past the cap the
+    # next verdict falls back to exit 75
+    max_generations: int = 8
+    # barrier/membership state directory; empty = <log_root>/elastic
+    # (must be on the shared filesystem, like heartbeats)
+    state_dir: str = ""
+
+
+@dataclass
 class ResilienceConfig:
     """Fault-tolerance knobs (resilience/ subsystem; docs/resilience.md).
     The reference had none of this — failure handling was "SLURM restarts
@@ -403,6 +460,8 @@ class ResilienceConfig:
     io_retries: int = 3
     # distributed health watchdog knobs (resilience.watchdog.*)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    # elastic mesh shrink/grow knobs (resilience.elastic.*)
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
 
 @dataclass

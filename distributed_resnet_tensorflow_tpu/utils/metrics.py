@@ -616,8 +616,9 @@ EVENT_SCHEMAS = {
             "step": "step at export time",
             "wall_secs": "wall seconds classified in this interval",
             "seconds": "per-category seconds {compute, input_wait, "
-                       "checkpoint, eval, stall, restart} — compute is "
-                       "the interval remainder (telemetry/goodput.py)",
+                       "checkpoint, eval, stall, restart, reshard} — "
+                       "compute is the interval remainder "
+                       "(telemetry/goodput.py)",
             "pct": "per-category percentages; sum to ~100 of wall by "
                    "construction",
         },
@@ -652,6 +653,41 @@ EVENT_SCHEMAS = {
                         "was skipped without touching the serving params",
             "to_step_attempted": "the rejected checkpoint's step (rejected "
                                  "rows only; applied rows carry to_step)",
+        },
+    },
+    "reshard": {
+        "emitted_by": "resilience/elastic.py ElasticRuntime (one row per "
+                      "completed mesh-generation transition; docs/"
+                      "resilience.md elastic mesh)",
+        "fields": {
+            "generation": "mesh generation ENTERED by this transition",
+            "reason": "what triggered it (peer_lost | hang | grow | "
+                      "rejoin)",
+            "old_hosts": "process count of the generation left behind",
+            "new_hosts": "process count of the new generation",
+            "restore_step": "committed checkpoint step the new generation "
+                            "resumed from (-1 = fresh init, no committed "
+                            "checkpoint existed)",
+            "global_batch": "global batch size of the new generation "
+                            "(resilience.elastic.batch_policy)",
+            "barrier_ms": "join-barrier wall time (membership settle + "
+                          "commit)",
+            "total_ms": "whole transition wall time: barrier + teardown + "
+                        "re-init + restore + rebuild",
+        },
+    },
+    "mesh_generation": {
+        "emitted_by": "resilience/elastic.py ElasticRuntime (chief, one "
+                      "row when a generation starts stepping — including "
+                      "generation 0 of an elastic run)",
+        "fields": {
+            "generation": "the mesh generation now live",
+            "hosts": "live process count in this generation",
+            "devices": "global device count in this generation",
+            "step": "first step of this generation's step loop",
+            "coordinator": "epoch-suffixed coordinator address the "
+                           "generation initialized over "
+                           "(parallel/distributed.py)",
         },
     },
 }

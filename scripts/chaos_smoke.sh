@@ -12,6 +12,9 @@
 #                                     # training children)
 #   scripts/chaos_smoke.sh --fast     # seconds-fast pre-merge gate:
 #                                     # shardcheck + -m "not slow and not heavy"
+#   scripts/chaos_smoke.sh --elastic  # elastic-mesh e2e only: freeze one of
+#                                     # four workers; assert shrink->grow with
+#                                     # rc=0 and NO exit-75 (docs/resilience.md)
 #   scripts/chaos_smoke.sh -k nan     # just the NaN-recovery cases
 #
 # NOTE: the subprocess/watchdog chaos tests are marked `slow` (tier-1 of
@@ -66,6 +69,62 @@ print("overlap family sweep OK:",
 print(json.dumps(fams))
 '
   fi
+fi
+
+if [[ "${1:-}" == "--elastic" ]]; then
+  shift
+  # Elastic-mesh smoke (docs/resilience.md): freeze one of FOUR workers
+  # mid-training. The frozen worker's own watchdog exits it 75 (hang in the
+  # host-local 'data' phase); the survivors defer their collective-hang
+  # exits, attribute the peer loss, and shrink into a 3-host generation
+  # restored from the last committed step; the supervisor's respawned
+  # rejoiner grows the mesh back to 4 hosts; the run completes rc=0 — the
+  # exit-75 requeue contract is now the FALLBACK, not the outcome.
+  TROOT=$(mktemp -d)
+  trap 'rm -rf "$TROOT"' EXIT
+  PORT=$((20000 + RANDOM % 20000))
+  set +e
+  timeout -k 10 420 env JAX_PLATFORMS=cpu DRT_FAULT_FREEZE_AT_BATCH="3:8" \
+    python -m distributed_resnet_tensorflow_tpu.launch \
+    --num_processes 4 --devices_per_process 1 --port "$PORT" \
+    --elastic --max_respawns 2 --respawn_delay_secs 2 -- \
+    --preset smoke \
+    --set model.name=logistic --set model.input_size=192 \
+    --set model.num_classes=10 --set data.image_size=8 \
+    --set train.batch_size=16 --set train.train_steps=60 \
+    --set train.log_every_steps=5 --set "log_root=$TROOT" \
+    --set checkpoint.save_every_steps=5 --set checkpoint.save_every_secs=0 \
+    --set resilience.elastic.enabled=on \
+    --set resilience.elastic.settle_secs=1 \
+    --set resilience.watchdog.enabled=on \
+    --set resilience.watchdog.interval_secs=0.2 \
+    --set resilience.watchdog.peer_timeout_secs=5 \
+    --set resilience.watchdog.min_step_timeout_secs=3 \
+    --set resilience.watchdog.grace_secs=1
+  rc=$?
+  set -e
+  if [[ $rc -ne 0 ]]; then
+    echo "chaos_smoke --elastic: run exited $rc, expected 0 (no requeue)" >&2
+    exit 1
+  fi
+  python - "$TROOT/train/metrics.jsonl" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+gens = {r["generation"] for r in rows if r.get("event") == "mesh_generation"}
+reshards = [r for r in rows if r.get("event") == "reshard"]
+reasons = {r["reason"] for r in reshards}
+assert {0, 1, 2} <= gens, f"expected generations 0,1,2, saw {gens}"
+assert "peer_lost" in reasons and "grow" in reasons, reasons
+shrink = next(r for r in reshards if r["reason"] == "peer_lost")
+grow = next(r for r in reshards if r["reason"] == "grow")
+assert (shrink["old_hosts"], shrink["new_hosts"]) == (4, 3), shrink
+assert (grow["old_hosts"], grow["new_hosts"]) == (3, 4), grow
+assert shrink["restore_step"] >= 0, "shrink restarted instead of resuming"
+print("elastic smoke: shrink restored step", shrink["restore_step"],
+      "-> grow live at generation", grow["generation"])
+PY
+  echo "chaos_smoke: elastic shrink->grow verified (rc=0, no exit-75)"
+  exit 0
 fi
 
 # ${arr[@]+...} form: bash <4.4 trips set -u on expanding an empty array

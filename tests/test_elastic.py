@@ -1,0 +1,406 @@
+"""Elastic mesh tests (resilience/elastic.py; docs/resilience.md).
+
+Fast tier: the CoordinatorSM decision machine on a fake clock, the file
+driver (join/commit/generation records), batch rescaling, the coordinator
+contract, heartbeat tombstones, and the listener reset semantics — no
+subprocesses, no jax world.
+
+Slow tier: THE acceptance scenario — freeze one of four launch.py workers
+mid-training; the survivors must reach mesh generation 2 (shrink), the
+supervisor's respawned rejoiner must bring the fleet back (grow), the
+whole run must end rc=0 with NO exit-75 requeue, and the loss trajectory
+must stay continuous against an unkilled oracle.
+"""
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from distributed_resnet_tensorflow_tpu.parallel.distributed import (
+    elastic_coordinator)
+from distributed_resnet_tensorflow_tpu.resilience.elastic import (
+    CoordinatorSM, ElasticImpossible, ElasticRuntime, ElasticState,
+    rescaled_batch)
+from distributed_resnet_tensorflow_tpu.resilience.heartbeat import (
+    tombstone_departed)
+from distributed_resnet_tensorflow_tpu.resilience.preemption import (
+    PreemptionListener)
+from distributed_resnet_tensorflow_tpu.utils.config import ExperimentConfig
+
+
+# ---------------------------------------------------------------------------
+# CoordinatorSM: pure decisions on a fake clock
+# ---------------------------------------------------------------------------
+
+def test_sm_chief_commits_after_settle():
+    sm = CoordinatorSM(0, min_hosts=2, settle_secs=2.0, timeout_secs=60.0)
+    assert sm.step(0.0, {0, 1}, None) == ("wait", None)   # first sighting
+    assert sm.step(1.0, {0, 1}, None) == ("wait", None)   # settling
+    assert sm.step(2.0, {0, 1}, None) == ("commit", None)
+
+
+def test_sm_non_chief_never_commits_and_adopts_commit():
+    sm = CoordinatorSM(1, min_hosts=2, settle_secs=0.5, timeout_secs=60.0)
+    for t in (0.0, 1.0, 5.0, 20.0):
+        assert sm.step(t, {0, 1}, None) == ("wait", None)
+    record = {"generation": 1, "members": [0, 1]}
+    assert sm.step(21.0, {0, 1}, record) == ("done", record)
+
+
+def test_sm_chief_absent_membership_times_out():
+    # worker 0 hosts the next coordinator: a membership without it must
+    # never commit — everyone waits out the barrier into the 75 fallback
+    sm = CoordinatorSM(1, min_hosts=2, settle_secs=0.5, timeout_secs=30.0)
+    assert sm.step(0.0, {1, 2}, None) == ("wait", None)
+    assert sm.step(10.0, {1, 2}, None) == ("wait", None)
+    action, why = sm.step(30.0, {1, 2}, None)
+    assert action == "abort" and "timed out" in why
+
+
+def test_sm_membership_flap_resets_settle_window():
+    sm = CoordinatorSM(0, min_hosts=2, settle_secs=2.0, timeout_secs=60.0)
+    assert sm.step(0.0, {0, 1}, None) == ("wait", None)
+    # a third worker lands mid-settle: the window restarts so several
+    # near-simultaneous changes collapse into ONE transition
+    assert sm.step(1.5, {0, 1, 2}, None) == ("wait", None)
+    assert sm.step(3.0, {0, 1, 2}, None) == ("wait", None)  # 1.5s < 2s
+    assert sm.step(3.6, {0, 1, 2}, None) == ("commit", None)
+
+
+def test_sm_commit_without_us_aborts():
+    sm = CoordinatorSM(2, min_hosts=2, settle_secs=0.5, timeout_secs=60.0)
+    action, why = sm.step(0.0, {2}, {"generation": 1, "members": [0, 1]})
+    assert action == "abort" and "without worker 2" in why
+
+
+def test_sm_below_min_hosts_never_commits():
+    sm = CoordinatorSM(0, min_hosts=2, settle_secs=0.5, timeout_secs=10.0)
+    assert sm.step(0.0, {0}, None) == ("wait", None)
+    assert sm.step(5.0, {0}, None) == ("wait", None)
+    assert sm.step(10.0, {0}, None)[0] == "abort"
+
+
+# ---------------------------------------------------------------------------
+# batch rescaling + the coordinator contract
+# ---------------------------------------------------------------------------
+
+def test_rescaled_batch_per_host_keeps_shard_slice():
+    assert rescaled_batch("per_host", 16, 4, 3) == (12, "per_host")
+    assert rescaled_batch("per_host", 16, 4, 2) == (8, "per_host")
+
+
+def test_rescaled_batch_keep_global_when_divisible():
+    assert rescaled_batch("keep_global", 16, 4, 2) == (16, "keep_global")
+
+
+def test_rescaled_batch_keep_global_falls_back_on_indivisible():
+    # 16 % 3 != 0 — silently flooring would train a different batch than
+    # configured, so the policy degrades to per_host with a warning
+    assert rescaled_batch("keep_global", 16, 4, 3) == (12, "per_host")
+
+
+def test_elastic_coordinator_port_stride():
+    assert elastic_coordinator("127.0.0.1:8476", 0, 7) == "127.0.0.1:8476"
+    assert elastic_coordinator("127.0.0.1:8476", 2, 7) == "127.0.0.1:8490"
+
+
+def test_elastic_coordinator_requires_host():
+    with pytest.raises(ValueError):
+        elastic_coordinator("8476", 1)
+
+
+# ---------------------------------------------------------------------------
+# ElasticState: the shared-directory barrier driver
+# ---------------------------------------------------------------------------
+
+def test_state_join_and_members(tmp_path):
+    st = ElasticState(str(tmp_path))
+    assert st.members(1) == set()
+    st.post_join(1, 0, {"reason": "peer_lost"})
+    st.post_join(1, 2, {"reason": "peer_lost"})
+    assert st.members(1) == {0, 2}
+    assert st.read_commit(1) is None
+
+
+def test_state_commit_is_exclusive_first_writer_wins(tmp_path):
+    st = ElasticState(str(tmp_path))
+    first = st.try_commit(1, {"generation": 1, "members": [0, 1]})
+    second = st.try_commit(1, {"generation": 1, "members": [0, 1, 2]})
+    # the second writer must ADOPT the first record, not overwrite it
+    assert first["members"] == [0, 1]
+    assert second["members"] == [0, 1]
+    assert st.read_commit(1)["members"] == [0, 1]
+
+
+def test_state_generation_roundtrip_and_round_cleanup(tmp_path):
+    st = ElasticState(str(tmp_path))
+    st.post_join(1, 0, {})
+    st.post_join(2, 0, {})
+    st.write_generation({"generation": 2, "members": [0, 1]})
+    assert st.read_generation()["generation"] == 2
+    st.cleanup_rounds(2)
+    assert st.members(1) == set()   # round-1 is history
+    assert st.members(2) == {0}     # the live round's files stay
+
+
+# ---------------------------------------------------------------------------
+# heartbeat tombstones + listener reset across generations
+# ---------------------------------------------------------------------------
+
+def test_tombstone_departed_drops_only_departed_ranks(tmp_path):
+    d = str(tmp_path)
+    for name in ("proc0.json", "proc1.json", "proc1.final.json",
+                 "proc3.json", "proc3.final.json", "notabeat.txt"):
+        with open(os.path.join(d, name), "w") as f:
+            f.write("{}")
+    removed = tombstone_departed(d, keep_process_ids=[0, 1])
+    assert removed == 2
+    left = sorted(os.listdir(d))
+    assert left == ["notabeat.txt", "proc0.json", "proc1.final.json",
+                    "proc1.json"]
+
+
+def test_listener_reset_clears_programmatic_stop_only():
+    lst = PreemptionListener()
+    lst.request_stop("peer_lost: proc3 beats stale")
+    assert lst.should_stop() and lst.reason().startswith("peer_lost")
+    lst.reset()
+    assert not lst.should_stop()
+    assert lst.reason() == "not preempted"
+
+
+def test_listener_reset_preserves_signal_stop():
+    lst = PreemptionListener()
+    # a REAL operator/SLURM signal must keep stopping the run across
+    # generations — reset only forgives programmatic stop requests
+    lst._reason = "signal SIGTERM"
+    lst._event.set()
+    lst.reset()
+    assert lst.should_stop()
+    assert lst.reason() == "signal SIGTERM"
+
+
+# ---------------------------------------------------------------------------
+# ElasticRuntime against a real config (virtual 8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+def _elastic_cfg(tmp_path, **overrides):
+    cfg = ExperimentConfig()
+    cfg.log_root = str(tmp_path)
+    cfg.mesh.num_processes = 4
+    cfg.mesh.process_id = 0
+    cfg.mesh.coordinator_address = "127.0.0.1:9000"
+    cfg.train.batch_size = 64
+    e = cfg.resilience.elastic
+    e.enabled = "on"
+    e.min_hosts = 1
+    e.settle_secs = 0.0
+    e.poll_secs = 0.05
+    for key, val in overrides.items():
+        setattr(e, key, val)
+    return cfg
+
+
+def test_runtime_disabled_without_peers(tmp_path):
+    cfg = _elastic_cfg(tmp_path)
+    cfg.mesh.num_processes = 1
+    assert not ElasticRuntime(cfg).enabled
+
+
+def test_runtime_can_reshard_needs_explicit_coordinator(tmp_path):
+    cfg = _elastic_cfg(tmp_path)
+    cfg.mesh.coordinator_address = ""  # SLURM/TPU-pod autodetect shape
+    rt = ElasticRuntime(cfg)
+    assert rt.enabled and not rt.can_reshard()
+
+
+def test_runtime_watchdog_defer_is_bounded(tmp_path):
+    now = [0.0]
+    cfg = _elastic_cfg(tmp_path, reshard_timeout_secs=30.0)
+    rt = ElasticRuntime(cfg, clock=lambda: now[0])
+    assert rt.watchdog_defer()          # first call arms the bound
+    now[0] = 29.0
+    assert rt.watchdog_defer()
+    now[0] = 31.0
+    assert not rt.watchdog_defer()      # bound exceeded: let the 75 happen
+
+
+def test_runtime_rank_and_derive_config(tmp_path):
+    cfg = _elastic_cfg(tmp_path)
+    cfg.mesh.process_id = 2
+    rt = ElasticRuntime(cfg)
+    record = {"generation": 1, "members": [0, 2, 3],
+              "coordinator": "127.0.0.1:9007", "restore_step": 5,
+              "global_batch": 48}
+    assert rt.rank(record) == 1         # sorted member index, chief stays 0
+    cfg2 = rt.derive_config(record)
+    assert cfg2.mesh.num_processes == 3
+    assert cfg2.mesh.process_id == 1
+    assert cfg2.mesh.coordinator_address == "127.0.0.1:9007"
+    assert cfg2.train.batch_size == 48
+    # the source config is untouched (deepcopy)
+    assert cfg.mesh.num_processes == 4 and cfg.train.batch_size == 64
+
+
+def test_runtime_single_worker_transition_commits(tmp_path):
+    """The whole barrier driven end to end in one process: worker 0 posts
+    its join, settles, commits, and adopts its own record."""
+    rt = ElasticRuntime(_elastic_cfg(tmp_path))
+    record = rt.transition("peer_lost", lambda: 7)
+    assert record["generation"] == 1
+    assert record["members"] == [0]
+    assert record["restore_step"] == 7
+    assert record["reason"] == "peer_lost"
+    # epoch-suffixed coordinator: base port + generation * stride
+    assert record["coordinator"] == \
+        f"127.0.0.1:{9000 + rt.ecfg.port_stride}"
+    # per_host policy: per-shard slice constant (64 over 4 hosts x 8
+    # devices = 2/shard), global batch scales to the 1-host world
+    assert record["global_batch"] == 16
+    assert rt.generation == 1 and rt.members == {0}
+
+
+def test_runtime_transition_times_out_without_chief(tmp_path):
+    cfg = _elastic_cfg(tmp_path, barrier_timeout_secs=0.4)
+    cfg.mesh.process_id = 1             # non-chief: can never commit
+    rt = ElasticRuntime(cfg)
+    with pytest.raises(ElasticImpossible):
+        rt.transition("peer_lost", lambda: None)
+    assert not rt.in_transition         # state cleared for the 75 fallback
+
+
+def test_runtime_two_workers_meet_in_the_barrier(tmp_path):
+    """Two runtimes over the SAME state dir (the two-process shape without
+    subprocesses): the chief commits, the peer adopts the same record."""
+    cfg0 = _elastic_cfg(tmp_path, min_hosts=2)
+    cfg1 = _elastic_cfg(tmp_path, min_hosts=2)
+    cfg1.mesh.process_id = 1
+    rt0, rt1 = ElasticRuntime(cfg0), ElasticRuntime(cfg1)
+    out = {}
+
+    def drive(name, rt):
+        out[name] = rt.transition("peer_lost", lambda: 3)
+
+    threads = [threading.Thread(target=drive, args=(n, rt), daemon=True)
+               for n, rt in (("chief", rt0), ("peer", rt1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert out["chief"] == out["peer"]
+    assert out["chief"]["members"] == [0, 1]
+    assert out["chief"]["restore_step"] == 3
+
+
+def test_runtime_pending_join_sees_only_new_workers(tmp_path):
+    rt = ElasticRuntime(_elastic_cfg(tmp_path))
+    assert not rt.pending_join(force=True)
+    rt.state.post_join(1, 0, {})        # an existing member is not news
+    assert not rt.pending_join(force=True)
+    rt.state.post_join(1, 5, {})        # a rejoiner is
+    assert rt.pending_join(force=True)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: kill-and-reshard, then grow back (slow tier)
+# ---------------------------------------------------------------------------
+
+def _elastic_launch_args(tmp_path, train_steps, elastic=True):
+    args = [
+        "--preset", "smoke",
+        "--set", "model.name=logistic",
+        "--set", "model.input_size=192",
+        "--set", "model.num_classes=10",
+        "--set", "data.image_size=8",
+        "--set", "train.batch_size=16",
+        "--set", f"train.train_steps={train_steps}",
+        "--set", "train.log_every_steps=1000",
+        "--set", "train.summary_every_steps=5",
+        "--set", f"log_root={tmp_path}",
+        "--set", "checkpoint.save_every_steps=5",
+        "--set", "checkpoint.save_every_secs=0",
+        "--set", "resilience.watchdog.enabled=on",
+        "--set", "resilience.watchdog.interval_secs=0.2",
+        "--set", "resilience.watchdog.peer_timeout_secs=5",
+        "--set", "resilience.watchdog.min_step_timeout_secs=3",
+        "--set", "resilience.watchdog.grace_secs=1",
+    ]
+    if elastic:
+        args += ["--set", "resilience.elastic.enabled=on",
+                 "--set", "resilience.elastic.settle_secs=1"]
+    return args
+
+
+def _metric_rows(tmp_path):
+    path = os.path.join(str(tmp_path), "train", "metrics.jsonl")
+    try:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except FileNotFoundError:
+        return []
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow  # multi-minute 4-process subprocess scenario — chaos_smoke.sh --elastic territory, not tier-1
+@pytest.mark.heavy
+def test_elastic_kill_and_reshard_grows_back(tmp_path):
+    """Freeze one of four workers: the survivors must shrink to a 3-host
+    generation and keep stepping from the last committed checkpoint, the
+    supervisor's rejoiner must grow the fleet back to 4 hosts, and the
+    run must complete rc=0 — zero exit-75 requeues. The loss trajectory
+    must stay continuous against an unkilled oracle."""
+    from distributed_resnet_tensorflow_tpu.launch import launch_local
+
+    steps = 60
+    elastic_root = tmp_path / "elastic"
+    os.environ["DRT_FAULT_FREEZE_AT_BATCH"] = "3:8"
+    try:
+        rc = launch_local(
+            4, _elastic_launch_args(elastic_root, steps),
+            devices_per_process=1, port=_free_port(),
+            elastic=True, max_respawns=2, respawn_delay_secs=2.0)
+    finally:
+        os.environ.pop("DRT_FAULT_FREEZE_AT_BATCH", None)
+    assert rc == 0, f"elastic run must complete without a requeue (rc={rc})"
+
+    rows = _metric_rows(elastic_root)
+    gens = [r for r in rows if r.get("event") == "mesh_generation"]
+    reshards = [r for r in rows if r.get("event") == "reshard"]
+    seen_gens = {r["generation"] for r in gens}
+    assert {0, 1, 2} <= seen_gens, (seen_gens, reshards)
+    reasons = {r["reason"] for r in reshards}
+    assert "peer_lost" in reasons and "grow" in reasons, reasons
+    shrink = next(r for r in reshards if r["reason"] == "peer_lost")
+    grow = next(r for r in reshards if r["reason"] == "grow")
+    assert (shrink["old_hosts"], shrink["new_hosts"]) == (4, 3)
+    assert (grow["old_hosts"], grow["new_hosts"]) == (3, 4)
+    assert shrink["restore_step"] >= 0   # resumed, not restarted
+    scalar_steps = [r["step"] for r in rows if "event" not in r]
+    assert scalar_steps and max(scalar_steps) >= steps
+
+    # loss continuity: the final loss must land in the same regime as an
+    # unkilled 4-process oracle (loose — the reshard replays a few batches
+    # and rescales the global batch, exact equality is not the contract)
+    oracle_root = tmp_path / "oracle"
+    rc = launch_local(4, _elastic_launch_args(oracle_root, steps,
+                                              elastic=False),
+                      devices_per_process=1, port=_free_port())
+    assert rc == 0
+    def final_loss(root):
+        losses = [r["loss"] for r in _metric_rows(root)
+                  if "event" not in r and "loss" in r]
+        assert losses, f"no loss scalars under {root}"
+        return losses[-1]
+    killed, oracle = final_loss(elastic_root), final_loss(oracle_root)
+    assert abs(killed - oracle) < max(0.5, 0.5 * abs(oracle)), \
+        (killed, oracle)

@@ -119,7 +119,10 @@ def test_overlap_composes_with_fused_multi_step(devices):
 
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(data=8),
-    MeshConfig(data=4, fsdp=2),
+    # dp_fsdp re-tiered out of the 870s tier-1 (~16s: two accumulated
+    # trainings on the sharded layout); the dp leg keeps the bit-identity
+    # claim in tier-1, the full (unfiltered) suite runs both
+    pytest.param(MeshConfig(data=4, fsdp=2), marks=pytest.mark.slow),
 ], ids=["dp", "dp_fsdp"])
 def test_accum_bucketed_is_bit_identical_and_wire_is_1x(mesh_cfg):
     """The acceptance claim for the accumulation scan: many-vs-one-bucket
@@ -200,7 +203,11 @@ def _mesh_subset(mesh_cfg):
 
 
 @pytest.mark.parametrize("mesh_cfg,experts,expect_axes", [
-    (MeshConfig(data=4, tensor=2), 0, {"data+fsdp"}),
+    # dp_tp re-tiered out of the 870s tier-1 (~16s: ViT leg pair on the
+    # tensor-sharded layout); dp_pp and dp_pp_ep keep the multi-axis
+    # overlap claim in tier-1, the full (unfiltered) suite runs all three
+    pytest.param(MeshConfig(data=4, tensor=2), 0, {"data+fsdp"},
+                 marks=pytest.mark.slow),
     (MeshConfig(data=2, pipeline=2), 0,
      {"data+fsdp", "data+fsdp+pipeline"}),
     (MeshConfig(data=2, pipeline=2, expert=2), 2,

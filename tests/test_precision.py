@@ -242,6 +242,7 @@ def test_compressed_exchange_zero1_composition_bit_identical(devices):
     np.testing.assert_array_equal(many, one)
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (~17s: three full bucketed-exchange trainings over one plan); runs in the full (unfiltered) suite
 def test_compressed_exchange_halves_wire_bytes_same_plan(devices):
     """The acceptance claim, three runs over ONE bucket plan: (a) the
     compressed exchange halves per-bucket wire bytes on the SAME plan
