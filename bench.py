@@ -870,6 +870,37 @@ def _overlap_ab(n_steps: int = 20):
                                   "bottleneck_bucket",
                                   "lowest_bandwidth_bucket",
                                   "schedule_key")}
+                    # what-if planner cross-check (ISSUE 17): cost this
+                    # very leg from its own probe bandwidths + the off
+                    # leg's measured compute, and hold the prediction
+                    # against the measured step — the tolerance the
+                    # drift sentinel and tests/test_planner.py assume
+                    from distributed_resnet_tensorflow_tpu.telemetry.\
+                        planner import BandwidthTable, OVERLAP_EFFICIENCY
+                    bw = BandwidthTable.from_probe(timing) \
+                        or BandwidthTable.reference()
+                    snap = rows[label]["plan"]
+                    comm = 0.0
+                    for wire, sig in zip(
+                            snap["bucket_wire_bytes"],
+                            snap.get("bucket_reduce_axes",
+                                     ["data"] * snap["buckets"])):
+                        bps, lat = bw.lookup(sig)
+                        comm += lat + int(wire) / bps
+                    compute = rows["off"]["step_ms"] / 1000.0
+                    exposed = max(0.0,
+                                  comm - OVERLAP_EFFICIENCY * compute)
+                    predicted = compute + exposed
+                    measured = dt / n_steps
+                    rows[label]["planner"] = {
+                        "predicted_step_ms": round(predicted * 1e3, 3),
+                        "measured_step_ms": round(measured * 1e3, 3),
+                        "predicted_over_measured": round(
+                            predicted / measured, 3),
+                        "predicted_comm_ms": round(comm * 1e3, 3),
+                        "measured_comm_ms": round(
+                            timing["comm_secs_total"] * 1e3, 3),
+                        "bandwidth_source": bw.source}
             except Exception as e:  # the A/B numbers stand alone
                 rows[label]["comm_report"] = {
                     "error": f"{type(e).__name__}: {e}"[:200]}

@@ -43,6 +43,12 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
                         "dispatch, untimed-blocking-call, chief-gated-"
                         "collective, lock-order-cycle); elaborate then "
                         "re-owns the overlap/compress step traces")
+    p.add_argument("--no-plan-drift", action="store_true",
+                   help="skip the plan-drift phase (ISSUE 17): the "
+                        "what-if planner's predictions over the "
+                        "committed schedules, the plan_catalog.json "
+                        "refresh, and the bandwidth-catalog sanity "
+                        "cross-check")
     p.add_argument("--root", default=None, help=argparse.SUPPRESS)
     # --root scopes the LINT pass to another tree (tests of the exit-code
     # contract run the real CLI over a known-bad fixture repo)
@@ -112,6 +118,30 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
                 # (the artifact diff must only ever mean a comm change)
                 path = write_artifact(sigs)
                 print(f"hangcheck-schedule: wrote {path}")
+        if not ns.no_plan_drift:
+            # plan-drift (docs/planner.md): the what-if planner re-costed
+            # over the committed collective schedules with the reference
+            # constants, plus the measured bandwidth-catalog cross-check
+            # against a live micro-probe — a comm/perf regression becomes
+            # a reviewable plan_catalog.json diff, a corrupted bandwidth
+            # table a red gate
+            from .plan_drift import run_plan_drift, write_plan_catalog
+            t4 = time.perf_counter()
+            sigs_for_plan = None
+            if not ns.no_hangcheck:
+                sigs_for_plan = sigs  # the freshly traced map
+            pfs, plan_doc = run_plan_drift(sigs_for_plan,
+                                           n_devices=ns.devices)
+            print(f"plan-drift: {len(pfs)} finding(s), "
+                  f"{len(plan_doc.get('plans', {}))} preset plan(s) "
+                  f"[{time.perf_counter() - t4:.1f}s]")
+            findings += pfs
+            if presets is None and ns.root is None and ns.devices == 8:
+                # same refresh guard as the schedule artifact above: the
+                # plan catalog must only ever diff on a real model /
+                # schedule change, never on a partial or resized run
+                path = write_plan_catalog(plan_doc)
+                print(f"plan-drift: wrote {path}")
 
     from .report import format_findings
     print(format_findings(findings, verbose=ns.verbose))

@@ -310,6 +310,46 @@ def launch_local(num_processes: int, main_args: List[str],
     return rc
 
 
+def _apply_auto_layout(main_args: List[str], num_processes: int,
+                       devices_per_process: int) -> List[str]:
+    """--auto-layout: resolve the preset the children will run, ask the
+    planner for the fastest predicted layout at this world size, and
+    prepend the matching ``--set mesh.*`` overrides. Prepend, not
+    append: config overrides apply in order, so a user's explicit
+    ``--set mesh.*`` later in main_args still wins. Planner failures
+    (no committed schedules for the preset, import error on an exotic
+    install) log and fall through to the preset's own mesh — the
+    launcher must never refuse to launch over an advisory."""
+    preset = "cifar10_resnet50"  # utils.config.parse_args default
+    for i, a in enumerate(main_args):
+        if a == "--preset" and i + 1 < len(main_args):
+            preset = main_args[i + 1]
+        elif a.startswith("--preset="):
+            preset = a.split("=", 1)[1]
+    n_devices = num_processes * devices_per_process
+    try:
+        from .telemetry.planner import recommend_layout
+        rec = recommend_layout(preset, n_devices=n_devices)
+    except Exception as e:  # advisory only — never block the launch
+        log.warning("--auto-layout: planner failed (%s); launching "
+                    "with the preset's own mesh", e)
+        return main_args
+    if rec is None:
+        log.warning("--auto-layout: no committed schedules for preset "
+                    "%r (run `main.py check` first); launching with "
+                    "the preset's own mesh", preset)
+        return main_args
+    layout, mesh_cfg = rec
+    overrides = []
+    for axis in ("data", "fsdp", "tensor", "pipeline", "sequence",
+                 "expert"):
+        overrides += ["--set", f"mesh.{axis}={getattr(mesh_cfg, axis)}"]
+    log.info("--auto-layout: planner recommends %s for %s @ %d "
+             "device(s): %s", layout, preset, n_devices,
+             " ".join(overrides[1::2]))
+    return overrides + list(main_args)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="local multi-process SPMD launcher/supervisor")
@@ -333,12 +373,22 @@ def main(argv=None):
     ap.add_argument("--respawn_delay_secs", type=float, default=2.0,
                     help="delay before an elastic respawn (lets the "
                          "survivors reach the join barrier first)")
+    ap.add_argument("--auto-layout", action="store_true",
+                    help="ask the what-if planner (telemetry/planner."
+                         "recommend_layout, docs/planner.md) for the "
+                         "fastest predicted mesh layout at this world "
+                         "size and inject the matching --set mesh.* "
+                         "overrides BEFORE the user's own args (an "
+                         "explicit --set mesh.* still wins)")
     ap.add_argument("main_args", nargs=argparse.REMAINDER,
                     help="args after -- go to main.py")
     ns = ap.parse_args(argv)
     main_args = ns.main_args
     if main_args and main_args[0] == "--":
         main_args = main_args[1:]
+    if ns.auto_layout:
+        main_args = _apply_auto_layout(
+            main_args, ns.num_processes, ns.devices_per_process or 1)
     sys.exit(launch_local(ns.num_processes, main_args,
                           ns.devices_per_process, ns.port,
                           child_grace_secs=ns.child_grace_secs,

@@ -46,7 +46,8 @@ from .train.hooks import (CheckpointHook, CkptAsyncHook, CkptShardHook,
                           CommTimingHook, CorruptRecordsHook, GoodputHook,
                           HeartbeatHook, InputEchoHook, InputStagesHook,
                           LoggingHook, MemoryHook, NanGuardHook,
-                          PrecisionHook, SummaryHook, Zero1Hook)
+                          PlanDriftHook, PrecisionHook, SummaryHook,
+                          Zero1Hook)
 from .train.loop import Trainer
 from .utils.config import (ExperimentConfig, parse_args,
                            resolve_checkpoint_dir, stacked_layout_stamp)
@@ -232,6 +233,10 @@ def _arm_watchdog_hooks(hooks: list, publisher) -> None:
         # cadence saves flip to the unmonitored "save" phase — a slow
         # shared-FS save must not read as a hang
         if isinstance(h, CheckpointHook):
+            h.heartbeat = publisher
+        # the drift sentinel's measured step time should be the
+        # watchdog's own EWMA, not a second competing estimate
+        if isinstance(h, PlanDriftHook):
             h.heartbeat = publisher
 
 
@@ -630,6 +635,14 @@ def _train_one_generation(cfg: ExperimentConfig, listener,
         if trainer.comm_overlap_active and cfg.telemetry.comm_timing:
             hooks.append(CommTimingHook(writer,
                                         cfg.train.summary_every_steps))
+        # predicted-vs-measured drift sentinel (telemetry/planner.py,
+        # docs/planner.md): the what-if model's prediction for THIS run
+        # held against the heartbeat/probe/memory measurements; "auto"
+        # arms lazily once the bucketed exchange has traced
+        if cfg.telemetry.plan_drift != "off" \
+                and trainer.comm_overlap_active:
+            hooks.append(PlanDriftHook(writer, cfg, trainer,
+                                       cfg.train.summary_every_steps))
     # per-host accounting exported by EVERY process (the chief's stream
     # alone would claim 1/N of the cluster): sharded-checkpoint bytes
     # (ckpt_shard) and the device-memory trend (memory — each host
@@ -945,6 +958,11 @@ def run_train_and_eval(cfg: ExperimentConfig):
             if trainer.comm_overlap_active and cfg.telemetry.comm_timing:
                 hooks.append(CommTimingHook(
                     writer, cfg.train.summary_every_steps))
+            # drift sentinel: see run_train
+            if cfg.telemetry.plan_drift != "off" \
+                    and trainer.comm_overlap_active:
+                hooks.append(PlanDriftHook(
+                    writer, cfg, trainer, cfg.train.summary_every_steps))
     # per-host sharded-ckpt + device-memory accounting: every process
     # exports, like run_train (the monitor's per-host rollup reads these)
     te_shard_writer = None
@@ -1062,6 +1080,14 @@ def main(argv=None):
         # per-bucket exchange timings into achieved bytes/sec per bucket
         from .telemetry.comm_report import main_comm_report
         sys.exit(main_comm_report(argv[1:]))
+    if argv and argv[0] == "plan":
+        # what-if performance planner (telemetry/planner.py,
+        # docs/planner.md): predict step time / HBM watermark / comm
+        # fraction per layout × knob candidate from the committed
+        # collective schedules × the fabric's bandwidth catalog, rank
+        # them, RECOMMEND a layout — no cluster needed
+        from .telemetry.planner import main_plan
+        sys.exit(main_plan(argv[1:]))
     serve_cmd = False
     if argv and argv[0] == "serve":
         # inference server (serve/, docs/serving.md): same flags as the

@@ -1042,4 +1042,13 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
     comm_timing_stats.record(buckets, total, max(1, reps), axes, compress)
     log.info("comm probe: %d bucket(s), %.2f ms standalone exchange "
              "(compress=%s)", len(buckets), total * 1e3, compress)
-    return comm_timing_stats.snapshot()
+    result = comm_timing_stats.snapshot()
+    # persist the measurement into the per-fabric bandwidth catalog
+    # (telemetry/bandwidth.py) so main.py comm-report and the what-if
+    # planner can cost layouts without a live mesh. Chief-only: the
+    # catalog file is one per fabric, and N processes racing the same
+    # atomic replace would keep only an arbitrary winner's fold
+    if jax.process_index() == 0:
+        from ..telemetry.bandwidth import update_from_probe
+        update_from_probe(result)
+    return result

@@ -1,0 +1,196 @@
+"""Per-fabric achieved-bandwidth catalog (``results/bandwidth/<fabric>.json``).
+
+``parallel/overlap.probe_comm_plan`` measures what each planned exchange
+bucket's collective actually achieves on the live mesh — but until now
+that measurement died with the run: ``main.py comm-report`` needed a
+fresh probe and the what-if planner (telemetry/planner.py) had nothing
+measured to cost candidate layouts against. This module persists every
+probe into a small per-fabric catalog keyed by the reduce-axis set, so
+any later process on the same fabric can read achieved bytes/sec without
+holding a live mesh.
+
+A *fabric* is the hardware the numbers are valid for: platform ×
+device kind × global device count (``fabric_id``) — a v4-32's ICI numbers
+must never cost a v5e-8 plan, and the virtual-8 CPU mesh the tests/gate
+run on gets its own file.
+
+Catalog schema (``schema_version`` 1, documented in docs/planner.md)::
+
+    {
+     "schema_version": 1,
+     "fabric": "cpu-8",            # fabric_id() of the measuring run
+     "platform": "cpu",
+     "device_kind": "cpu",
+     "devices": 8,
+     "axes": {                     # keyed by the probe's reduce-axis set
+      "data+fsdp": {
+       "bytes_per_sec": 4.1e8,     # best standalone WIRE bytes/sec seen
+       "latency_secs": 2.3e-4,     # smallest per-collective cost seen
+       "samples": 12,              # probe buckets folded in, ever
+       "min_wire_bytes": 20480,    # payload range the numbers came from
+       "max_wire_bytes": 4194304
+      }, ...
+     }
+    }
+
+Merging is best-achieved: ``bytes_per_sec`` only ratchets up and
+``latency_secs`` only down — the probe times collectives standalone
+(best-of-reps), so the catalog is the fabric's demonstrated ceiling, the
+right operand for a planner that predicts what a layout *could* do.
+Writes are atomic (tmp + ``os.replace``) and never raise: losing one
+probe's persistence must not kill training.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+SCHEMA_VERSION = 1
+
+#: env override for the catalog directory (tests point it at a tmpdir;
+#: multi-user clusters point it at a shared results tree)
+DIR_ENV = "DRT_BANDWIDTH_DIR"
+
+
+def catalog_dir() -> str:
+    override = os.environ.get(DIR_ENV)
+    if override:
+        return override
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo_root, "results", "bandwidth")
+
+
+def fabric_id(devices=None) -> str:
+    """``<platform>-<n>`` (plus the device kind when it says more than
+    the platform does): the key deciding which catalog file a
+    measurement lands in / a prediction reads from."""
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    d0 = devices[0]
+    platform = str(getattr(d0, "platform", "unknown")).lower()
+    kind = str(getattr(d0, "device_kind", "") or "").lower()
+    parts = [platform]
+    if kind and kind != platform:
+        parts.append(kind)
+    parts.append(str(len(devices)))
+    return re.sub(r"[^a-z0-9.]+", "-", "-".join(parts)).strip("-")
+
+
+def catalog_path(fabric: Optional[str] = None) -> str:
+    return os.path.join(catalog_dir(), f"{fabric or fabric_id()}.json")
+
+
+def load_catalog(path: Optional[str] = None,
+                 fabric: Optional[str] = None) -> Optional[dict]:
+    """The catalog document, or None when absent/unreadable (callers
+    fall back to the planner's reference table / a live probe)."""
+    path = path or catalog_path(fabric)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        log.debug("bandwidth catalog unreadable at %s (%s)", path, e)
+        return None
+    if not isinstance(doc, dict) or not isinstance(doc.get("axes"), dict):
+        log.warning("bandwidth catalog at %s is malformed; ignoring", path)
+        return None
+    return doc
+
+
+def lookup(catalog: Optional[dict], axes_sig: str) -> Optional[dict]:
+    """The axes entry for a reduce-axis signature (``"data+fsdp"``),
+    falling back to the entry sharing the most axis names (a dp_tp
+    prediction on a fabric only probed under dp still gets the measured
+    order of magnitude rather than nothing). Deterministic: ties break
+    on the entry name."""
+    if not catalog:
+        return None
+    axes = catalog.get("axes", {})
+    entry = axes.get(axes_sig)
+    if entry is not None:
+        return entry
+    want = set(axes_sig.split("+"))
+    best = None
+    for name in sorted(axes):
+        overlap = len(want & set(name.split("+")))
+        key = (overlap, axes[name].get("samples", 0))
+        if best is None or key > best[0]:
+            best = (key, axes[name])
+    return best[1] if best else None
+
+
+def update_from_probe(snapshot: Optional[dict],
+                      path: Optional[str] = None,
+                      devices=None) -> Optional[str]:
+    """Fold one ``probe_comm_plan`` snapshot (``utils.metrics.
+    comm_timing_stats`` shape: per-bucket wire bytes / probe secs /
+    axes) into the fabric's catalog. Returns the path written, or None
+    when there was nothing to record / the write failed (logged, never
+    raised — persistence is observability, not correctness)."""
+    if not snapshot or not snapshot.get("buckets"):
+        return None
+    try:
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        fabric = fabric_id(devices)
+        path = path or catalog_path(fabric)
+        doc = load_catalog(path) or {
+            "schema_version": SCHEMA_VERSION,
+            "fabric": fabric,
+            "platform": str(getattr(devices[0], "platform", "unknown")),
+            "device_kind": str(getattr(devices[0], "device_kind", "")),
+            "devices": len(devices),
+            "axes": {},
+        }
+        axes: Dict[str, dict] = doc.setdefault("axes", {})
+        for b in snapshot["buckets"]:
+            sig = b.get("axes") or "data"
+            wire = int(b.get("wire_bytes", 0))
+            bw = float(b.get("wire_bytes_per_sec", 0.0))
+            secs = float(b.get("probe_secs", 0.0))
+            if wire <= 0 or bw <= 0 or secs <= 0:
+                continue
+            e = axes.get(sig)
+            if e is None:
+                axes[sig] = {"bytes_per_sec": bw, "latency_secs": secs,
+                             "samples": 1, "min_wire_bytes": wire,
+                             "max_wire_bytes": wire}
+            else:
+                e["bytes_per_sec"] = max(float(e["bytes_per_sec"]), bw)
+                e["latency_secs"] = min(float(e["latency_secs"]), secs)
+                e["samples"] = int(e.get("samples", 0)) + 1
+                e["min_wire_bytes"] = min(int(e["min_wire_bytes"]), wire)
+                e["max_wire_bytes"] = max(int(e["max_wire_bytes"]), wire)
+        if not axes:
+            return None
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        log.info("bandwidth catalog: folded %d bucket(s) into %s",
+                 len(snapshot["buckets"]), path)
+        return path
+    except Exception:  # pragma: no cover - persistence is best effort
+        log.exception("bandwidth catalog update failed (probe results "
+                      "still live in comm_timing_stats)")
+        return None
+
+
+def list_catalogs() -> List[str]:
+    """Every fabric catalog present (for ``main.py plan`` discovery)."""
+    try:
+        d = catalog_dir()
+        return sorted(os.path.join(d, f) for f in os.listdir(d)
+                      if f.endswith(".json"))
+    except OSError:
+        return []

@@ -152,6 +152,50 @@ def find_rows(root: str) -> Tuple[Optional[dict], Optional[dict]]:
     return timing, overlap
 
 
+def synthesize_timing(overlap: dict,
+                      catalog: Optional[dict] = None) -> Optional[dict]:
+    """A ``comm_timing``-shaped dict MODELED from the run's
+    ``comm_overlap`` bucket plan × the fabric's persisted bandwidth
+    catalog (telemetry/bandwidth.py) — the no-live-probe path: a run
+    whose probe was off (telemetry.comm_timing=false) or whose mesh is
+    gone can still be attributed from what this fabric has measured
+    before. Buckets carry ``modeled: True`` so the report and its
+    consumers cannot mistake a model for a measurement. None when
+    either side is missing."""
+    from . import bandwidth as bw_mod
+    from .planner import BandwidthTable
+    if not overlap or not overlap.get("bucket_wire_bytes"):
+        return None
+    catalog = catalog if catalog is not None else bw_mod.load_catalog()
+    table = BandwidthTable.from_catalog(catalog)
+    if table is None:
+        return None
+    wires = overlap["bucket_wire_bytes"]
+    sizes = overlap.get("bucket_bytes") or wires
+    leaves = overlap.get("bucket_leaves") or [0] * len(wires)
+    sigs = overlap.get("bucket_reduce_axes") or ["data"] * len(wires)
+    buckets = []
+    total = 0.0
+    for i, (wire, size, nl, sig) in enumerate(
+            zip(wires, sizes, leaves, sigs)):
+        bps, lat = table.lookup(sig)
+        secs = lat + int(wire) / bps
+        total += secs
+        buckets.append({
+            "bucket": i, "bytes": int(size), "wire_bytes": int(wire),
+            "leaves": int(nl), "axes": sig,
+            "probe_secs": round(secs, 6),
+            "wire_bytes_per_sec": round(int(wire) / secs, 1)
+            if secs > 0 else 0.0,
+            "modeled": True,
+        })
+    return {"buckets": buckets, "comm_secs_total": round(total, 6),
+            "reps": 0, "axes": sorted({a for s in sigs
+                                       for a in s.split("+")}),
+            "compress": overlap.get("compress", "off"),
+            "modeled_from_catalog": (catalog or {}).get("fabric", "?")}
+
+
 def build_report(timing: dict, overlap: Optional[dict] = None,
                  signatures: Optional[Dict[str, dict]] = None,
                  key: Optional[str] = None,
@@ -174,6 +218,7 @@ def build_report(timing: dict, overlap: Optional[dict] = None,
             if comm_total > 0 else 0.0
     report: dict = {
         "buckets": buckets,
+        "modeled_from_catalog": timing.get("modeled_from_catalog"),
         "comm_secs_total": comm_total,
         "compress": timing.get("compress", "off"),
         "axes": timing.get("axes"),
@@ -211,6 +256,10 @@ def build_report(timing: dict, overlap: Optional[dict] = None,
 
 def render(report: dict) -> str:
     lines = ["== comm-report :: per-bucket runtime attribution =="]
+    if report.get("modeled_from_catalog"):
+        lines.append("  NOTE: timings MODELED from the bandwidth "
+                     f"catalog (fabric {report['modeled_from_catalog']}"
+                     ") — no live probe ran (docs/planner.md)")
     if report.get("schedule_key"):
         lines.append(f"  schedule: {report['schedule_key']} "
                      f"({report['schedule_matched']}/"
@@ -273,14 +322,26 @@ def main_comm_report(argv=None) -> int:
                     help="a no-/unbucketed-exchange step time to "
                          "difference against (bench overlap row 'off' "
                          "leg) -> achieved overlap fraction")
+    ap.add_argument("--catalog", default=None,
+                    help="bandwidth-catalog path to model timings from "
+                         "when no comm_timing row exists (default: this "
+                         "fabric's results/bandwidth/<fabric>.json)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON")
     ns = ap.parse_args(argv)
     timing, overlap = find_rows(ns.root)
+    if timing is None and overlap is not None:
+        # no live probe, but the run left its bucket plan and the fabric
+        # has a persisted catalog: model the timings instead of refusing
+        from . import bandwidth as bw_mod
+        catalog = bw_mod.load_catalog(path=ns.catalog) \
+            if ns.catalog else bw_mod.load_catalog()
+        timing = synthesize_timing(overlap, catalog)
     if timing is None:
         print(f"comm-report: no comm_timing row under {ns.root} — the "
               "probe runs when comm.overlap is active and "
-              "telemetry.comm_timing is on")
+              "telemetry.comm_timing is on (and no comm_overlap row + "
+              "bandwidth catalog existed to model from)")
         return 1
     schedule_path = ns.schedules or default_schedule_path()
     signatures = load_schedules(schedule_path)

@@ -49,7 +49,9 @@ log = logging.getLogger(__name__)
 
 #: bump when the trace.json event shape changes (consumers key on it via
 #: the ``trace_dump`` metrics row and the file's otherData block)
-SPAN_SCHEMA_VERSION = 7  # 7: + reshard.* family (elastic mesh
+SPAN_SCHEMA_VERSION = 8  # 8: + plan.predict/plan.drift_check (what-if
+#                              performance planner, round 17)
+#                          7: + reshard.* family (elastic mesh
 #                              shrink/grow transition, round 16)
 #                          6: + comm.probe; comm.bucket / zero1.gather
 #                              gain a bucket-index arg so the merged
@@ -147,6 +149,13 @@ SPAN_CATALOG = {
                        "layout is sharded)",
     "reshard.rebuild": "Trainer/mesh/sharding re-elaboration + input "
                        "source rebuild for the new generation",
+    # what-if performance planner (telemetry/planner.py)
+    "plan.predict": "one layout × knob candidate costed by the analytic "
+                    "model (preset/layout args; main.py plan and the "
+                    "plan-drift gate phase)",
+    "plan.drift_check": "one predicted-vs-measured comparison by the "
+                        "drift sentinel (train/hooks.py PlanDriftHook "
+                        "cadence firing)",
 }
 
 # unknown span names already warned about (warn once, like write_event)

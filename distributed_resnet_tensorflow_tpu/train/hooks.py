@@ -431,6 +431,140 @@ class CommTimingHook(_SnapshotExportHook):
         return gate
 
 
+class PlanDriftHook(_CadenceHook):
+    """The predicted-vs-measured drift sentinel (docs/planner.md). At the
+    first cadence after the bucketed exchange has traced, the chief
+    builds THIS run's analytic prediction (telemetry/planner.predict_live
+    — step time, comm seconds, per-device HBM, costed from the live
+    bucket plan × the fabric's bandwidth catalog), exports it as one
+    ``{"event": "plan"}`` row, and arms a planner.DriftSentinel. Every
+    cadence after that it compares the prediction against what the run
+    actually measures — step time from the heartbeat EWMA (falling back
+    to this hook's own rate pairs when no watchdog runs), comm seconds
+    from the comm_timing probe, HBM from the live memory sample — and a
+    sustained divergence beyond telemetry.plan_tolerance becomes a
+    ``{"event": "plan_drift"}`` row plus a flight-recorder dump: the
+    model said this run should cost X, the machine disagrees, go look.
+    Chief-only (the prediction and the measurements are per-run, not
+    per-process)."""
+
+    def __init__(self, writer: MetricsWriter, cfg, trainer,
+                 every_steps: int = 100):
+        self.writer = writer
+        self.cfg = cfg
+        self.trainer = trainer
+        self.every_steps = max(1, every_steps)
+        # main._arm_watchdog_hooks points this at the HeartbeatPublisher
+        # so the measured step time is the watchdog's own EWMA — one
+        # number, not two competing estimates
+        self.heartbeat = None
+        self._sentinel = None
+        self._predicted: Optional[dict] = None
+        self._rate_prev: Optional[tuple] = None  # (monotonic, step)
+        self._warned = False
+
+    def reset_window(self) -> None:
+        """LoggingHook protocol: a rate pair spanning the eval/checkpoint
+        pause between segments would read as a step-time regression."""
+        self._rate_prev = None
+
+    def _arm(self) -> bool:
+        from ..telemetry import planner
+        bw = planner.measured_bandwidth_table() \
+            or planner.BandwidthTable.reference()
+        pred = planner.predict_live(self.cfg, self.trainer, bandwidth=bw)
+        if pred is None:
+            if self.cfg.telemetry.plan_drift == "on" and not self._warned:
+                self._warned = True
+                log.warning(
+                    "telemetry.plan_drift=on but no prediction could be "
+                    "built yet (the bucketed exchange has not traced — "
+                    "comm.overlap off?); the sentinel stays disarmed")
+            return False
+        tcfg = self.cfg.telemetry
+        self._predicted = pred
+        self._sentinel = planner.DriftSentinel(
+            pred, tolerance=tcfg.plan_tolerance,
+            window=tcfg.plan_drift_window,
+            cooldown_secs=tcfg.plan_drift_cooldown_secs)
+        self.writer.write_event("plan", {
+            "preset": self.cfg.model.name,
+            "layout": planner.layout_label(self.cfg.mesh),
+            "devices": jax.device_count(),
+            "knobs": {
+                "precision": self.cfg.train.precision,
+                "zero1": self.cfg.optimizer.zero1,
+                "compress": self.cfg.comm.compress,
+                "bucket_mb": self.cfg.comm.bucket_mb,
+                "accum": self.cfg.train.grad_accum_steps,
+            },
+            "predicted": pred,
+            "bandwidth_source": bw.source,
+            "recommended": True,  # the layout actually running
+        })
+        log.info("plan-drift sentinel armed: predicted step %.3fms, "
+                 "comm %.3fms, HBM %s (bandwidth: %s)",
+                 pred["step_secs"] * 1e3, pred["comm_secs"] * 1e3,
+                 pred.get("hbm_bytes"), bw.source)
+        return True
+
+    def _measured(self, now: float, step: int) -> Dict[str, float]:
+        """The live values to hold against the prediction; only metrics
+        that actually have a measurement this cadence are checked."""
+        out: Dict[str, float] = {}
+        prev, self._rate_prev = self._rate_prev, (now, step)
+        if self.heartbeat is not None:
+            ewma = self.heartbeat.snapshot().get("ewma_step_secs")
+            if ewma:
+                out["step_secs"] = float(ewma)
+        if "step_secs" not in out and prev is not None \
+                and step > prev[1] and now > prev[0]:
+            out["step_secs"] = (now - prev[0]) / (step - prev[1])
+        from ..utils.metrics import comm_timing_stats
+        timing = comm_timing_stats.snapshot()
+        if timing is not None:
+            out["comm_secs"] = float(timing["comm_secs_total"])
+        if self._predicted and self._predicted.get("hbm_bytes"):
+            from ..telemetry.memory import sample_memory
+            sample = sample_memory()
+            peaks = [d.get("live_peak_bytes", 0)
+                     for d in sample.get("devices", {}).values()]
+            if peaks and max(peaks) > 0:
+                out["hbm_bytes"] = float(max(peaks))
+        return out
+
+    def __call__(self, step: int, state, metrics: Dict[str, Any]) -> None:
+        if not cadence_crossed(step, self.every_steps, self._last):
+            return
+        self._last = step
+        now = time.monotonic()
+        if self._sentinel is None:
+            if not self._arm():
+                self._rate_prev = (now, step)
+            return
+        from ..telemetry.tracer import recorder
+        with recorder.span("plan.drift_check", step=step):
+            for metric, measured in self._measured(now, step).items():
+                firing = self._sentinel.check(metric, measured)
+                if firing is None:
+                    continue
+                dump = recorder.dump_on_anomaly(
+                    "plan_drift",
+                    detail=f"{metric} predicted "
+                           f"{firing['predicted']:.6g} measured "
+                           f"{firing['measured']:.6g} at step {step}")
+                self.writer.write_event("plan_drift",
+                                        {"step": step, **firing,
+                                         "dump": dump})
+                self.writer.flush()
+                log.warning(
+                    "plan drift: %s measured %.6g vs predicted %.6g "
+                    "(ratio %.2f beyond tolerance %.1f for %d windows)",
+                    metric, firing["measured"], firing["predicted"],
+                    firing["ratio"], firing["tolerance"],
+                    firing["windows"])
+
+
 class MemoryHook(_SnapshotExportHook):
     """Export the device/host memory sample (telemetry/memory.py:
     per-device live-array bytes + allocator stats where present, host

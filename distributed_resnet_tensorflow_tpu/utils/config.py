@@ -547,6 +547,24 @@ class TelemetryConfig:
     # not dump a trace per detection tick); the episode also re-arms only
     # after a healthy sample
     anomaly_cooldown_secs: float = 60.0
+    # -- predicted-vs-measured drift sentinel (train/hooks.PlanDriftHook,
+    # telemetry/planner.py, docs/planner.md) ----------------------------
+    # arm the sentinel: at run start the chief predicts step time / comm
+    # seconds / HBM from the live bucket plan × the fabric's bandwidth
+    # catalog, emits one {"event": "plan"} row, then compares measured
+    # values (heartbeat EWMA step time, comm_timing probe, memory rows)
+    # each cadence. "auto" = on when the prediction can be built (overlap
+    # active), "on" forces a warning when it cannot, "off" disarms.
+    plan_drift: str = "auto"
+    # divergence band: fire when measured/predicted leaves
+    # [1/tol, tol] for plan_drift_window consecutive checks. The analytic
+    # model is a roofline, not a simulator — 3x either way means the
+    # model or the machine is wrong, not that the model is 20% off.
+    plan_tolerance: float = 3.0
+    plan_drift_window: int = 8
+    # minimum gap between plan_drift firings (each one dumps the flight
+    # recorder); an episode re-arms only after an in-tolerance check
+    plan_drift_cooldown_secs: float = 300.0
 
 
 @dataclass

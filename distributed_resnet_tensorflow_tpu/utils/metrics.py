@@ -690,6 +690,50 @@ EVENT_SCHEMAS = {
                            "(parallel/distributed.py)",
         },
     },
+    "plan": {
+        "emitted_by": "telemetry/planner.py (main.py plan, and the chief "
+                      "at run start when the drift sentinel arms; "
+                      "docs/planner.md)",
+        "fields": {
+            "preset": "preset the prediction is for",
+            "layout": "layout name (dp | dp_fsdp | dp_tp | dp_pp | "
+                      "dp_pp_ep)",
+            "devices": "global device count the prediction assumes",
+            "knobs": "knob dict {precision, zero1, compress, bucket_mb, "
+                     "accum} the prediction assumes",
+            "predicted": "{step_secs, compute_secs, comm_secs, "
+                         "comm_exposed_secs, comm_fraction, "
+                         "hbm_bytes, wire_bytes} — the cost model's "
+                         "output (telemetry/planner.py)",
+            "bandwidth_source": "'catalog' (results/bandwidth/"
+                                "<fabric>.json), 'reference' (baked-in "
+                                "table) or 'probe' (live comm_timing)",
+            "recommended": "true on the row for the layout main.py plan "
+                           "ranked first (plan rows from a live run "
+                           "describe the running layout and omit this)",
+        },
+    },
+    "plan_drift": {
+        "emitted_by": "train/hooks.py PlanDriftHook (sustained "
+                      "predicted-vs-measured divergence beyond "
+                      "telemetry.plan_tolerance; docs/planner.md)",
+        "fields": {
+            "step": "step at detection",
+            "metric": "which observable diverged (step_secs | comm_secs "
+                      "| hbm_bytes)",
+            "predicted": "the cost model's value for this run's layout",
+            "measured": "the live value (heartbeat step EWMA / "
+                        "comm_timing probe total / memory row peak)",
+            "ratio": "measured / predicted (>= 1: slower/bigger than "
+                     "predicted; the sentinel fires on either side of "
+                     "tolerance)",
+            "tolerance": "telemetry.plan_tolerance the ratio exceeded",
+            "windows": "consecutive divergent checks before firing "
+                       "(telemetry.plan_drift_window)",
+            "dump": "trace.json path when the flight recorder dumped "
+                    "(absent when tracing is off)",
+        },
+    },
 }
 
 # unknown event names already warned about (warn once, not per row)

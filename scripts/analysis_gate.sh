@@ -11,10 +11,12 @@
 #     not as a step-1 _SpecError after a 20-minute queue wait.
 #
 #   scripts/analysis_gate.sh                 # full gate (lint + elaborate
-#                                            #   + zero1 sweep + hangcheck)
+#                                            #   + zero1 sweep + hangcheck
+#                                            #   + plan-drift)
 #   scripts/analysis_gate.sh --lint-only     # sub-second syntax/invariant pass
 #   scripts/analysis_gate.sh --no-hangcheck  # skip the hangcheck phases
-#                                            #   (mirrors --no-zero1-sweep)
+#                                            #   (mirrors --no-zero1-sweep,
+#                                            #   --no-plan-drift)
 #
 # Wired as a pre-submit step in scripts/submit_tpu_slurm.sh and into the
 # pre-merge chaos gate (scripts/chaos_smoke.sh --fast). Exit 0 = clean,
@@ -22,7 +24,11 @@
 #
 # Budget contract (docs/static_analysis.md): the FULL gate finishes in
 # <300 s — per-phase wall times are printed by the check CLI (lint /
-# elaborate / elab-zero1 / hangcheck-schedule lines), and this script
+# elaborate / elab-zero1 / hangcheck-schedule / plan-drift lines — the
+# plan-drift phase (ISSUE 17, docs/planner.md) re-costs the what-if
+# planner over the committed schedules and refreshes
+# analysis/plan_catalog.json; measured ~3-6 s, well inside the same
+# 300 s envelope), and this script
 # fails loudly when the total busts the budget, so creep shows up as a
 # red gate in the PR that caused it, not as a slow submit host months
 # later. Scoped runs (--lint-only, --preset, --no-*) enforce the same

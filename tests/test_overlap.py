@@ -99,6 +99,12 @@ def test_overlap_matches_default_path_to_float_rounding(mesh_cfg):
     assert abs(float(mo["loss"]) - float(mb["loss"])) < 1e-4
 
 
+# re-tiered out of the 870s tier-1 (ISSUE 17, ~13s). Overlap×fused
+# multi-step composition: each side stays pinned in tier-1 on its own
+# (test_overlap_matches_default_path_to_float_rounding, the fused
+# multi-step tests in test_train), the full (unfiltered) suite runs
+# the cross.
+@pytest.mark.slow
 def test_overlap_composes_with_fused_multi_step(devices):
     """steps_per_loop > 1 wraps the shard_map'd step in lax.scan — the
     fused dispatch must produce the same params as the unfused loop."""
@@ -142,6 +148,12 @@ def test_accum_bucketed_is_bit_identical_and_wire_is_1x(mesh_cfg):
     assert float(m1["loss"]) == float(m2["loss"])
 
 
+# re-tiered out of the 870s tier-1 (ISSUE 17, ~16s: a second accum
+# exactness oracle). The accumulation contract stays pinned in tier-1
+# by test_accum_bucketed_is_bit_identical_and_wire_is_1x[dp] (bit
+# identity + wire accounting); the full (unfiltered) suite re-runs it
+# against this composition-matched jit oracle too.
+@pytest.mark.slow
 def test_accum_matches_composition_matched_jit_oracle(devices):
     """The accumulated exchange vs the plain jit accumulation scan. The
     body slices microbatches PER SHARD (each shard's local batch splits
@@ -210,8 +222,14 @@ def _mesh_subset(mesh_cfg):
                  marks=pytest.mark.slow),
     (MeshConfig(data=2, pipeline=2), 0,
      {"data+fsdp", "data+fsdp+pipeline"}),
-    (MeshConfig(data=2, pipeline=2, expert=2), 2,
-     {"data+fsdp", "data+fsdp+expert", "data+fsdp+pipeline+expert"}),
+    # dp_pp_ep legs-match re-tiered out of tier-1 too (ISSUE 17, ~16s):
+    # the dp_pp_ep layout keeps its tier-1 pin via
+    # test_vit_overlap_bucketing_bit_identical_dp_pp_ep (the stronger
+    # bit-identity claim); the full suite runs the allclose leg pair
+    pytest.param(MeshConfig(data=2, pipeline=2, expert=2), 2,
+                 {"data+fsdp", "data+fsdp+expert",
+                  "data+fsdp+pipeline+expert"},
+                 marks=pytest.mark.slow),
 ], ids=["dp_tp", "dp_pp", "dp_pp_ep"])
 def test_vit_overlap_legs_match_default_path(mesh_cfg, experts,
                                              expect_axes):

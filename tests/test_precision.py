@@ -291,6 +291,13 @@ def test_compress_requires_overlap_warns_loudly(caplog, devices):
         compress_dtype(_tiny_cfg(**{"comm.compress": "int4"}))
 
 
+# re-tiered out of the 870s tier-1 (ISSUE 17, ~13s: the triple
+# composition). Each pairwise leg stays pinned in tier-1
+# (test_compressed_exchange_zero1_composition_bit_identical,
+# test_compressed_exchange_bucketing_is_bit_identical, the accum
+# bit-identity leg in test_overlap); the full (unfiltered) suite runs
+# compress×zero1×accum together.
+@pytest.mark.slow
 def test_compress_and_zero1_compose_with_accumulation(caplog, devices):
     """The converted warning branch: gradient accumulation used to force
     the exchange off (comm.compress/optimizer.zero1 then warned and ran

@@ -375,6 +375,13 @@ def test_queue_delay_batching_under_concurrent_submitters(tmp_path):
 
 
 @pytest.mark.heavy
+# re-tiered out of the 870s tier-1 (ISSUE 17, ~20s: threaded hot-swap
+# soak under the dispatch sanitizer). The swap protocol stays covered
+# in tier-1 by the startup-fallback / mismatched-checkpoint /
+# restore-once tests, and the live serve plane (including swaps under
+# load) runs in scripts/obs_smoke.sh and scripts/chaos_smoke.sh; the
+# full (unfiltered) suite runs this soak.
+@pytest.mark.slow
 def test_threaded_swap_and_sanitizer_clean(tmp_path):
     """End-to-end with REAL dispatch + swap threads, under the cross-thread
     dispatch sanitizer: requests served, a checkpoint published mid-serve

@@ -82,6 +82,12 @@ def test_param_sharding_rule(mesh_dp_fsdp):
 
 
 @pytest.mark.heavy
+# re-tiered out of the 870s tier-1 (ISSUE 17, ~21s: the slowest single
+# test — a full sharded-vs-single-device training oracle). The sharded
+# step's numerics stay pinned in tier-1 by test_overlap's bucketed-vs-
+# default allclose legs and test_fsdp_state_sharding's spec checks; the
+# full (unfiltered) suite runs this oracle.
+@pytest.mark.slow
 def test_sharded_step_matches_single_device(mesh8):
     """The crux: dp-sharded training step == serial step (sync DP exactness).
     The reference could only approximate this promise through

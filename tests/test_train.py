@@ -149,6 +149,12 @@ def test_grad_accum_matches_big_batch():
 
 
 @pytest.mark.heavy
+# re-tiered out of the 870s tier-1 (ISSUE 17, ~13s: a full two-trainer
+# A/B against the optax oracle). The fused-xent kernel keeps its own
+# unit pins in tier-1 (test_ops) and the fused path trains in
+# test_loss_decreases_on_learnable_data; the full (unfiltered) suite
+# runs the end-to-end oracle.
+@pytest.mark.slow
 def test_fused_xent_train_step_matches_optax():
     """train.fused_xent=interpret (Pallas kernel, CPU interpreter) produces
     the same step as the optax path — including gradients, via the custom

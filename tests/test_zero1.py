@@ -84,7 +84,11 @@ def _opt_bytes_per_replica(state):
 
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(data=8),
-    MeshConfig(data=4, fsdp=2),
+    # dp_fsdp re-tiered out of the 870s tier-1 (ISSUE 17, ~12s): the dp
+    # leg pins the replicated-update equivalence; the dp_fsdp×zero1
+    # cross keeps its tier-1 pin via test_zero1_overlap_matches_plain_
+    # path[dp_fsdp], the full (unfiltered) suite runs this leg too
+    pytest.param(MeshConfig(data=4, fsdp=2), marks=pytest.mark.slow),
 ], ids=["dp", "dp_fsdp"])
 @pytest.mark.parametrize("opt", [
     "momentum",

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Join the per-round bench records (BENCH_r0*.json at the repo root)
+into ONE machine-readable perf trajectory.
+
+Each round's freeform ``parsed`` blob is flattened to dotted numeric
+keys (``overlap_ab.bucketed.steps_per_sec``, ...), and every key that
+also existed in the PREVIOUS round gets a delta row ``{abs, pct}`` —
+the cross-round regression signal the per-round files cannot show on
+their own. Rounds whose ``parsed`` is empty (r05: the harness crashed
+after the run, only the tail survived) are carried with
+``parsed_empty: true`` so a gap in the trajectory reads as a gap, not
+as a flat line.
+
+    python tools/bench_trajectory.py [--root DIR] [--json]
+    python -m distributed_resnet_tensorflow_tpu.main monitor --bench
+
+Stdlib-only on purpose: ``main.py monitor --bench`` loads this file by
+path (telemetry/monitor.py), so it must import without the package (or
+jax) on the path.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def flatten_numeric(node: Any, prefix: str = "") -> Dict[str, float]:
+    """Dotted-key view of every numeric leaf (bools excluded: rc-style
+    flags are identity, not magnitude). List elements key by index."""
+    out: Dict[str, float] = {}
+    if isinstance(node, bool):
+        return out
+    if isinstance(node, (int, float)):
+        out[prefix or "value"] = float(node)
+        return out
+    if isinstance(node, dict):
+        for k in sorted(node):
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten_numeric(node[k], key))
+    elif isinstance(node, (list, tuple)):
+        for i, v in enumerate(node):
+            key = f"{prefix}.{i}" if prefix else str(i)
+            out.update(flatten_numeric(v, key))
+    return out
+
+
+def discover_rounds(root: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def build_trajectory(paths: Sequence[str]) -> dict:
+    """The joined trajectory doc: one row per round, in filename order
+    (BENCH_rNN sorts chronologically), each with its flattened metrics
+    and the per-key delta against the PREVIOUS round that carried the
+    same key — not necessarily the adjacent round, so an empty round
+    (r05) does not sever every downstream delta."""
+    rows: List[dict] = []
+    last_seen: Dict[str, float] = {}  # key -> most recent value
+    for path in paths:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            rows.append({"round": os.path.basename(path),
+                         "error": str(e)})
+            continue
+        metrics = flatten_numeric(rec.get("parsed") or {})
+        deltas: Dict[str, dict] = {}
+        for key, val in metrics.items():
+            prev = last_seen.get(key)
+            if prev is None:
+                continue
+            d: Dict[str, float] = {"abs": round(val - prev, 9)}
+            if prev != 0:
+                d["pct"] = round((val - prev) / abs(prev) * 100.0, 2)
+            deltas[key] = d
+        last_seen.update(metrics)
+        rows.append({
+            "round": os.path.basename(path).replace("BENCH_", "")
+                                           .replace(".json", ""),
+            "n": rec.get("n"),
+            "rc": rec.get("rc"),
+            "cmd": rec.get("cmd"),
+            "parsed_empty": not metrics,
+            "metrics": metrics,
+            "deltas": deltas,
+        })
+    return {"schema_version": 1, "rounds": rows,
+            "keys_tracked": len(last_seen)}
+
+
+def render(traj: dict, top: int = 5) -> str:
+    lines = ["== bench trajectory :: "
+             f"{len(traj['rounds'])} round(s), "
+             f"{traj['keys_tracked']} metric key(s) =="]
+    for row in traj["rounds"]:
+        if "error" in row:
+            lines.append(f"  {row['round']}: UNREADABLE ({row['error']})")
+            continue
+        if row["parsed_empty"]:
+            lines.append(f"  {row['round']}: no parsed metrics "
+                         "(harness died post-run; tail only)")
+            continue
+        lines.append(f"  {row['round']}: {len(row['metrics'])} metric(s), "
+                     f"{len(row['deltas'])} delta(s) vs prior")
+        movers = sorted(
+            ((k, d) for k, d in row["deltas"].items() if "pct" in d),
+            key=lambda kd: -abs(kd[1]["pct"]))[:top]
+        for key, d in movers:
+            lines.append(f"      {d['pct']:>+8.1f}%  {key}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="join BENCH_r*.json rounds into one perf trajectory")
+    ap.add_argument("--root",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory holding BENCH_r*.json (default: "
+                         "the repo root this script lives in)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full machine-readable trajectory")
+    ap.add_argument("--top", type=int, default=5,
+                    help="biggest percentage movers to print per round")
+    ns = ap.parse_args(argv)
+    paths = discover_rounds(ns.root)
+    if not paths:
+        print(f"bench-trajectory: no BENCH_r*.json under {ns.root}")
+        return 1
+    traj = build_trajectory(paths)
+    if ns.json:
+        print(json.dumps(traj, indent=1, sort_keys=True))
+    else:
+        print(render(traj, top=ns.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
