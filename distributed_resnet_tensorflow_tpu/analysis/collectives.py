@@ -116,12 +116,24 @@ def collect_ops(jaxpr) -> List[dict]:
     for eqn in jaxpr.eqns:
         kind = WIRE_PRIMS.get(eqn.primitive.name)
         if kind is not None:
-            out.append({
+            op = {
                 "op": kind,
                 "axes": list(_axes_of(eqn)),
                 "operands": len(eqn.invars),
                 "bytes": _payload_bytes(eqn),
-            })
+            }
+            # grouped (two-tier) collectives — the hierarchical exchange
+            # (parallel/overlap): record the group SIZE (the tier width)
+            # and which tier the grouping selects — consecutive device
+            # blocks are the intra-host tier under the host-aware device
+            # order, strided columns the inter-host tier
+            groups = eqn.params.get("axis_index_groups")
+            if groups:
+                g0 = [int(x) for x in groups[0]]
+                op["groups"] = len(g0)
+                op["tier"] = "intra" if g0 == list(
+                    range(g0[0], g0[0] + len(g0))) else "inter"
+            out.append(op)
         for sub in _sub_jaxprs(eqn):
             out.extend(collect_ops(sub))
     return out
@@ -135,7 +147,13 @@ def extract_schedule(fn, *abstract_args) -> List[dict]:
 
 
 def _op_sig(op: dict) -> str:
-    return f"{op['op']}@" + "+".join(op["axes"])
+    sig = f"{op['op']}@" + "+".join(op["axes"])
+    # grouped collectives carry the group size, matching the declared
+    # plan's tier suffix ("psum_scatter@data[4]"); ungrouped ops keep the
+    # PRE-EXISTING signature form so committed artifacts stay byte-stable
+    if op.get("groups"):
+        sig += f"[{op['groups']}]"
+    return sig
 
 
 def check_declared_plan(schedule: Sequence[dict],
@@ -269,6 +287,16 @@ def run_collectives(preset_names: Optional[Sequence[str]] = None,
                     "compress": snap["compress"],
                     "declared_collectives": snap["declared_collectives"],
                 }
+                # hierarchical plans carry the tier factor, the per-op
+                # wire ledger and the inter-tier bytes (the 1/k claim,
+                # diffable in the artifact). Flat plans omit the keys so
+                # every PRE-EXISTING family stays byte-identical.
+                if snap.get("hierarchy"):
+                    entry["plan"]["hierarchy"] = snap["hierarchy"]
+                    entry["plan"]["bucket_op_wire_bytes"] = \
+                        snap["bucket_op_wire_bytes"]
+                    entry["plan"]["bucket_inter_wire_bytes"] = \
+                        snap["bucket_inter_wire_bytes"]
         if deterministic_retrace and schedule:
             second = builder()
             if second != schedule:
@@ -403,6 +431,37 @@ def run_collectives(preset_names: Optional[Sequence[str]] = None,
 
                         record(name, label, f"overlap+accum{accum}",
                                build_accum, deterministic_retrace=False,
+                               plan_check=True)
+
+                # the hierarchical exchange (comm.hierarchy, the staged
+                # RS→psum→AG restaging of every data-reducing bucket):
+                # one witness per batch layout of the det-probe — dp
+                # factors its 8-way data axis 4×2 (the virtual "2 hosts
+                # × 4 devices"), dp_fsdp factors 4-way as 2×2 and adds
+                # the fsdp-scatter composition. The explicit
+                # intra_axis_size override stands in for multi-host
+                # device order on the single-host CPU gate.
+                if not shaping and name == _DET_PROBE:
+                    dsz = max(mesh_cfg.data, 1)
+                    hk = dsz // 2 if dsz >= 4 and dsz % 2 == 0 else 0
+                    if hk > 1 and not dedupe(
+                            "overlap_hier", cfg, label,
+                            (cfg.comm.bucket_mb, hk)):
+
+                        def build_hier(cfg=cfg, mesh=mesh, hk=hk):
+                            hcfg = copy.deepcopy(cfg)
+                            hcfg.comm.overlap = "on"
+                            hcfg.comm.hierarchy = "on"
+                            hcfg.comm.intra_axis_size = hk
+                            trainer = _trainer_for(hcfg, mesh)
+                            state = _abstract_state(trainer, cfg)
+                            batch = _abstract_batch(
+                                hcfg, hcfg.train.batch_size)
+                            return extract_schedule(trainer._train_step,
+                                                    state, batch)
+
+                        record(name, label, "overlap+hier", build_hier,
+                               deterministic_retrace=(label == "dp"),
                                plan_check=True)
 
                 # (3) the full low-precision composition: bf16 step ×

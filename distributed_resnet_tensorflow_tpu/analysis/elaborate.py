@@ -340,6 +340,35 @@ def elaborate_config(cfg, mesh_cfg, locus: str,
                 "elab-overlap-step", locus,
                 "bucketed overlap + accumulation step", e))
 
+        # the hierarchical-exchange composition (comm.hierarchy=on): the
+        # staged RS -> inter-psum -> AG program is a different trace
+        # than the flat exchange — a grouped-collective spec error or a
+        # padding/rank bug in the staged concat must surface here, not
+        # when an operator first factors a real multi-host mesh. Forced
+        # via comm.intra_axis_size (no real host boundary on the gate's
+        # virtual mesh); batch-only layouts, data axis factorable.
+        try:
+            import copy
+            from ..parallel.overlap import overlap_unsupported_reason
+            shaped = any(mesh.shape.get(a, 1) > 1
+                         for a in ("pipeline", "tensor", "expert", "seq"))
+            dsize = int(mesh.shape.get("data", 1))
+            if trace_comm_variants and not shaped and dsize >= 4 \
+                    and dsize % 2 == 0:
+                hcfg = copy.deepcopy(cfg)
+                hcfg.comm.overlap = "on"
+                hcfg.comm.hierarchy = "on"
+                hcfg.comm.intra_axis_size = dsize // 2
+                if overlap_unsupported_reason(hcfg, mesh) is None:
+                    htrainer = Trainer(hcfg, mesh=mesh)
+                    batch = _abstract_batch(hcfg, hcfg.train.batch_size)
+                    jax.eval_shape(htrainer._train_step, state_shapes,
+                                   batch)
+        except Exception as e:
+            findings.append(_findings_from_exc(
+                "elab-overlap-step", locus,
+                "bucketed overlap + hierarchical exchange step", e))
+
         # bf16 precision-policy step (parallel/precision.py): the
         # train.precision=bf16 variant of this preset × layout, traced
         # abstractly over the SAME f32 master state shapes (the policy's

@@ -90,8 +90,54 @@ def create_mesh(mesh_cfg=None, devices: Optional[Sequence[jax.Device]] = None,
         dev_array = mesh_utils.create_device_mesh(
             axis_sizes, devices=np.asarray(devices))
     except Exception:
-        dev_array = np.asarray(devices).reshape(axis_sizes)
+        # host-aware fallback order: group each host's devices
+        # contiguously (stable by (process_index, id)) before the reshape,
+        # so consecutive ``data`` coordinates land on one host whenever
+        # the axis sizes allow — the layout data_axis_host_factorization
+        # below detects and the hierarchical exchange
+        # (parallel/overlap.py, comm.hierarchy) exploits
+        ordered = sorted(devices, key=lambda d: (
+            getattr(d, "process_index", 0), getattr(d, "id", 0)))
+        dev_array = np.asarray(ordered).reshape(axis_sizes)
     return Mesh(dev_array, AXES)
+
+
+def data_axis_host_factorization(mesh: Mesh) -> Optional[int]:
+    """The intra-host group size ``k`` along the ``data`` axis, or None.
+
+    Returns ``k`` (1 < k < data_size, k | data_size) when the data axis
+    splits into uniform blocks of ``k`` consecutive coordinates such
+    that, for every fixed coordinate on the other mesh axes, all ``k``
+    devices of a block live on ONE process (host) and different blocks
+    live on different hosts — the factorization the hierarchical
+    exchange (parallel/overlap.py, ``comm.hierarchy``) stages its
+    reduce-scatter / psum / all-gather tiers over. None when the axis is
+    trivial, single-host, or the device order interleaves hosts (no
+    honest fast/slow tier split exists; ``comm.intra_axis_size``
+    overrides for virtual meshes)."""
+    ax = {name: i for i, name in enumerate(mesh.axis_names)}
+    if "data" not in ax:
+        return None
+    dsize = mesh.shape.get("data", 1)
+    if dsize <= 1:
+        return None
+    # one row per data coordinate: the process index of every device at
+    # that coordinate, other-axis positions flattened in a fixed order
+    moved = np.moveaxis(mesh.devices, ax["data"], 0).reshape(dsize, -1)
+    rows = [tuple(getattr(d, "process_index", 0) for d in moved[i])
+            for i in range(dsize)]
+    k = 1
+    while k < dsize and rows[k] == rows[0]:
+        k += 1
+    if k <= 1 or k >= dsize or dsize % k:
+        return None
+    blocks = [rows[b * k:(b + 1) * k] for b in range(dsize // k)]
+    for blk in blocks:
+        if any(r != blk[0] for r in blk[1:]):
+            return None
+    if len({blk[0] for blk in blocks}) <= 1:
+        return None
+    return k
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

@@ -40,6 +40,27 @@ result is allclose at the compressed dtype's rounding, by design. Local
 gradient accumulation and the optimizer update stay f32 — only the wire
 format narrows.
 
+Hierarchical exchange (``comm.hierarchy``, arXiv:1811.05233's 2D-torus
+allreduce; arXiv:1711.04325's intra-node-reduce-then-inter-node): when
+the ``data`` axis factors into a fast intra-host tier of size k and a
+slow inter-host tier (host-aware device order — parallel/mesh.
+data_axis_host_factorization — or the explicit ``comm.intra_axis_size``
+override), each bucket's flat data-axis psum is restaged as
+reduce-scatter over the k intra-host peers → psum of the 1/k shard over
+the inter-host tier → all-gather back intra-host, all via
+``axis_index_groups`` on the ONE ``data`` axis (no mesh rebuild, no
+nested shard_map). The full payload crosses only the fast tier; the
+slow tier carries 1/k of it — the PR 10 fsdp-leaf trick generalized to
+every bucket. It composes with ``comm.compress`` (the cast precedes the
+staged collectives), zero1 (data-scattered leaves already move 1/N and
+stay on their flat scatter), and the accumulation scan (one staged
+exchange per optimizer step). Numerics: flat-vs-hierarchical is the
+same sum under a different association, so results agree to float
+rounding, not bitwise (tests pin bitwise equality on exactly-
+representable payloads, and bitwise determinism of the hierarchical
+plan against itself); many-vs-one-bucket stays bit-identical within
+either plan.
+
 Layout-aware exchange (the universal overlap envelope): the exchange is
 no longer batch-mesh-only. Per leaf, the reduce-axis set derives from
 the leaf's PartitionSpec — a tensor-/expert-/pipeline-sharded leaf keeps
@@ -172,16 +193,89 @@ def compress_dtype(cfg) -> Optional[str]:
     return mode
 
 
+def hierarchy_groups(k_intra: int, k_inter: int):
+    """``axis_index_groups`` for the two tiers of a factored ``data`` axis
+    of size ``k_intra × k_inter``: host-aware device order places a
+    host's devices CONSECUTIVELY along the axis, so the intra-tier
+    groups are the consecutive blocks ``[b·k, …, b·k+k-1]`` and the
+    inter-tier groups are the stride-k columns ``[r, r+k, …]`` (one peer
+    per host, matched by intra-host rank)."""
+    gi = [[b * k_intra + r for r in range(k_intra)] for b in range(k_inter)]
+    ge = [[b * k_intra + r for b in range(k_inter)] for r in range(k_intra)]
+    return gi, ge
+
+
+def hierarchy_factor(cfg, mesh: Mesh) -> Optional[int]:
+    """The intra-tier group size k for (cfg, mesh): the explicit
+    ``comm.intra_axis_size`` override when set (validated — must be a
+    non-trivial divisor of the data axis), else the host-derived
+    factorization (parallel/mesh.data_axis_host_factorization). None
+    when no non-trivial factorization exists."""
+    dsize = int(mesh.shape.get("data", 1))
+    k = int(getattr(cfg.comm, "intra_axis_size", 0) or 0)
+    if k:
+        if dsize <= 1 or k <= 1 or k >= dsize or dsize % k:
+            raise ValueError(
+                f"comm.intra_axis_size={k} must satisfy 1 < k < data axis "
+                f"size ({dsize}) and divide it — the hierarchical exchange "
+                "needs a non-trivial uniform two-tier factorization")
+        return k
+    from .mesh import data_axis_host_factorization
+    return data_axis_host_factorization(mesh)
+
+
+def resolve_hierarchy(cfg, mesh: Mesh) -> Optional[int]:
+    """``comm.hierarchy`` → the intra-tier size k or None (flat).
+    ``auto`` quietly stays flat when the mesh gives no factorization;
+    ``on`` raises instead of silently training a different program."""
+    mode = cfg.comm.hierarchy
+    if mode not in ("off", "auto", "on"):
+        raise ValueError(f"unknown comm.hierarchy setting {mode!r}")
+    if mode == "off":
+        return None
+    k = hierarchy_factor(cfg, mesh)
+    if k is None:
+        reason = ("the data axis has no intra/inter-host factorization "
+                  "(single host, trivial axis, or interleaved device "
+                  "order) and no comm.intra_axis_size override")
+        if mode == "on":
+            raise ValueError(f"comm.hierarchy=on is unsupported here: "
+                             f"{reason}")
+        log.info("comm.hierarchy=auto resolved flat: %s", reason)
+    return k
+
+
+def autotune_mode(cfg) -> str:
+    """``comm.autotune`` validated — "off" or "startup". Whether the
+    startup pass actually runs is the Trainer's call (it needs the
+    telemetry.comm_timing probe; see train/loop.py)."""
+    mode = getattr(cfg.comm, "autotune", "off")
+    if mode not in ("off", "startup"):
+        raise ValueError(f"unknown comm.autotune setting {mode!r}; "
+                         "supported: off, startup")
+    return mode
+
+
 @dataclass(frozen=True)
 class OverlapPlan:
     """Resolved overlap configuration for one (cfg, mesh).
 
     ``compress`` names the exchange payload dtype ("bf16"/"fp16") or None
     — carried on the plan because the gather leg (make_bucketed_gather)
-    and the exchange must agree, and both already receive the plan."""
+    and the exchange must agree, and both already receive the plan.
+
+    ``hierarchy`` is the intra-tier group size k of the two-tier data-axis
+    exchange (module docstring) or None (flat). ``autotune`` mirrors
+    ``comm.autotune``; ``tuned`` marks a plan REWRITTEN by the startup
+    autotune pass (telemetry/planner.tune_comm_plan) — the comm_overlap
+    row carries both so a tuned run is distinguishable from a hand-set
+    one."""
 
     bucket_bytes: int
     compress: Optional[str] = None
+    hierarchy: Optional[int] = None
+    autotune: str = "off"
+    tuned: bool = False
 
 
 class OverlapStats:
@@ -200,7 +294,11 @@ class OverlapStats:
                wire_bytes: Optional[Sequence[int]] = None,
                declared: Optional[Sequence[Sequence[str]]] = None,
                reduce_axes: Optional[Sequence[str]] = None,
-               accum_steps: int = 1) -> None:
+               accum_steps: int = 1,
+               hierarchy: Optional[int] = None,
+               autotune: str = "off", tuned: bool = False,
+               inter_wire: Optional[Sequence[int]] = None,
+               op_wire: Optional[Sequence[Sequence[int]]] = None) -> None:
         with self._lock:
             self._plan = {
                 "buckets": len(bucket_sizes),
@@ -228,6 +326,24 @@ class OverlapStats:
                 else [int(b) for b in bucket_sizes],
                 "wire_bytes": int(sum(wire_bytes)) if wire_bytes is not None
                 else int(total_bytes),
+                # hierarchical exchange (comm.hierarchy): the resolved
+                # intra-tier size k (0 = flat), whether the autotune pass
+                # chose this plan, and the per-bucket bytes crossing the
+                # SLOW inter-host tier — the 1/k acceptance number (flat:
+                # the full wire payload crosses it)
+                "hierarchy": int(hierarchy) if hierarchy else 0,
+                "autotune": autotune or "off",
+                "tuned": bool(tuned),
+                "bucket_inter_wire_bytes": [int(b) for b in inter_wire]
+                if inter_wire is not None
+                else ([int(b) for b in wire_bytes] if wire_bytes is not None
+                      else [int(b) for b in bucket_sizes]),
+                # per-bucket per-OP wire bytes, aligned 1:1 with the
+                # declared collective sequence — the planner/comm-report
+                # match staged (RS→psum→AG) plans op-by-op with these
+                "bucket_op_wire_bytes": [[int(x) for x in b]
+                                         for b in op_wire]
+                if op_wire is not None else None,
                 # per-bucket declared collective sequences (bucket order =
                 # issue order): what analysis/collectives.py cross-checks
                 # the traced jaxpr schedule against
@@ -321,7 +437,9 @@ def resolve_overlap(cfg, mesh: Mesh) -> Optional[OverlapPlan]:
         raise ValueError(
             f"comm.bucket_mb must be > 0, got {cfg.comm.bucket_mb}")
     return OverlapPlan(bucket_bytes=int(cfg.comm.bucket_mb * 2 ** 20),
-                       compress=compress_dtype(cfg))
+                       compress=compress_dtype(cfg),
+                       hierarchy=resolve_hierarchy(cfg, mesh),
+                       autotune=autotune_mode(cfg))
 
 
 def plan_buckets(leaf_bytes: Sequence[int],
@@ -407,8 +525,108 @@ def _param_specs(params: Any, mesh: Mesh):
                                   is_leaf=lambda x: hasattr(x, "spec"))
 
 
+def _resolve_hier(hierarchy, data_size, reduce_axes):
+    """(k_intra, k_inter) when the hierarchical staging applies to this
+    bucket — the bucket reduces over ``data`` and the factorization is
+    non-trivial — else None (flat). One resolution point shared by the
+    declared plan and the exchange so the two cannot disagree."""
+    if not hierarchy or "data" not in reduce_axes:
+        return None
+    k, dsize = int(hierarchy), int(data_size)
+    if dsize <= 1 or k <= 1 or k >= dsize or dsize % k:
+        return None
+    return k, dsize // k
+
+
+def _bucket_plan_ops(specs, out_specs=None, reduce_axes=BATCH_AXES,
+                     hierarchy=None, data_size=0, leaf_elems=None,
+                     wire_itemsize=4, fsdp_size=1) -> List[dict]:
+    """One bucket's collective-issue plan, op by op — the single source
+    both :func:`declared_bucket_collectives` (signature strings for the
+    hangcheck) and make_bucketed_grad's wire-byte accounting read, so the
+    declared schedule and the byte ledger cannot drift apart. Each op:
+
+      ``sig``   — ``"<kind>@<axis>[+<axis>…]"``, with a ``[k]`` suffix on
+                  grouped (two-tier) collectives naming the GROUP size —
+                  analysis/collectives.py tags traced ``axis_index_groups``
+                  ops the same way;
+      ``wire_bytes`` — that op's input payload in wire dtype bytes
+                  (0 when ``leaf_elems`` is not given);
+      ``inter`` — True when the payload crosses the slow data tier (a
+                  flat data psum/scatter moves the FULL payload across
+                  hosts; the staged plan's inter leg moves 1/k).
+
+    The op order is the issue order ``_exchange_bucket`` traces: the
+    replicated block first (tuple-psum, or its staged RS→psum→AG
+    restaging), then the per-leaf fsdp/zero1 ops, then the staged block
+    for fsdp-scattered remainders."""
+    if out_specs is None:
+        out_specs = specs
+    reduce_axes = tuple(reduce_axes)
+    hier = _resolve_hier(hierarchy, data_size, reduce_axes)
+    elems = list(leaf_elems) if leaf_elems is not None else [0] * len(specs)
+    ops: List[dict] = []
+
+    def add(sig, n_elems, inter=False):
+        ops.append({"sig": sig, "wire_bytes": int(n_elems) * wire_itemsize,
+                    "inter": inter})
+
+    def staged(total_elems, rest):
+        # the two-tier restaging of ``psum@data[+rest]``: RS over the k
+        # intra peers (payload padded to a multiple of k), psum of the
+        # 1/k shard across hosts (+ any non-data reduce axes, flat), AG
+        # the reduced shard back intra-host
+        k, k_inter = hier
+        padded = total_elems + (-total_elems) % k
+        shard = padded // k
+        add(f"psum_scatter@data[{k}]", padded)
+        add(f"psum@data[{k_inter}]", shard, inter=True)
+        if rest:
+            add("psum@" + "+".join(rest), shard)
+        add(f"all_gather@data[{k}]", shard)
+
+    z1_dims = [_axis_dim(o, "data") for o in out_specs]
+    rep_idx = [i for i, s in enumerate(specs)
+               if _fsdp_dim(s) is None and z1_dims[i] is None]
+    if rep_idx:
+        rep_elems = sum(elems[i] for i in rep_idx)
+        if hier is not None:
+            staged(rep_elems, tuple(a for a in reduce_axes if a != "data"))
+        else:
+            add("psum@" + "+".join(reduce_axes), rep_elems,
+                inter="data" in reduce_axes)
+    rem_axes = tuple(a for a in reduce_axes if a != "fsdp")
+    staged_elems = 0
+    staged_any = False
+    for i, spec in enumerate(specs):
+        d = _fsdp_dim(spec)
+        dz = z1_dims[i]
+        if d is None and dz is None:
+            continue
+        e = elems[i]
+        if d is not None:
+            add("psum_scatter@fsdp", e)
+            e = e // max(1, int(fsdp_size))
+        if dz is not None:
+            # zero1 leaves stay on the flat data scatter: they already
+            # move only 1/N and land in the shard layout — restaging
+            # would re-gather what the optimizer wants scattered
+            add("psum_scatter@data", e, inter=True)
+            if d is None:
+                add("psum@fsdp", e // max(1, int(data_size) or 1))
+        elif hier is not None:
+            staged_any = True
+            staged_elems += e
+        else:
+            add("psum@" + "+".join(rem_axes), e, inter="data" in rem_axes)
+    if hier is not None and staged_any:
+        staged(staged_elems, tuple(a for a in rem_axes if a != "data"))
+    return ops
+
+
 def declared_bucket_collectives(specs, out_specs=None,
-                                reduce_axes=BATCH_AXES) -> List[str]:
+                                reduce_axes=BATCH_AXES,
+                                hierarchy=None, data_size=0) -> List[str]:
     """The collective-issue sequence ``_exchange_bucket`` will emit for
     one bucket, as ``"<kind>@<axis>[+<axis>…]"`` strings — the DECLARED
     plan hangcheck's schedule extractor (analysis/collectives.py) checks
@@ -416,36 +634,52 @@ def declared_bucket_collectives(specs, out_specs=None,
     the bucket's reduce-axis set (``reduce_axes`` — the batch axes plus
     any shaping axes the leaves replicate over, parallel layouts); each
     fsdp/ZeRO-sharded leaf reduce-scatters FIRST on its sharded axis,
-    then psums (or scatters) the remainder. Must mirror
-    ``_exchange_bucket`` exactly — a drift between the two IS the gate
-    finding."""
-    if out_specs is None:
-        out_specs = specs
-    reduce_axes = tuple(reduce_axes)
-    ops: List[str] = []
-    z1_dims = [_axis_dim(o, "data") for o in out_specs]
-    if any(_fsdp_dim(s) is None and z1_dims[i] is None
-           for i, s in enumerate(specs)):
-        ops.append("psum@" + "+".join(reduce_axes))
-    for i, spec in enumerate(specs):
-        d = _fsdp_dim(spec)
-        dz = z1_dims[i]
-        if d is None and dz is None:
-            continue
-        if d is not None:
-            ops.append("psum_scatter@fsdp")
-        if dz is not None:
-            ops.append("psum_scatter@data")
-            if d is None:
-                ops.append("psum@fsdp")
-        else:
-            ops.append("psum@" + "+".join(a for a in reduce_axes
-                                          if a != "fsdp"))
-    return ops
+    then psums (or scatters) the remainder. Under ``hierarchy`` (the
+    intra-tier size k) the data-axis reductions restage as
+    ``psum_scatter@data[k] → psum@data[D/k] → all_gather@data[k]``
+    (module docstring). Must mirror ``_exchange_bucket`` exactly — a
+    drift between the two IS the gate finding."""
+    return [op["sig"] for op in _bucket_plan_ops(
+        specs, out_specs, reduce_axes, hierarchy, data_size)]
+
+
+def _hier_reduce(parts, k_intra, k_inter, rest_axes):
+    """All-reduce ``parts`` (a list of same-dtype leaves, summed over the
+    full ``data`` axis plus ``rest_axes``) via the two-tier staging:
+    flatten + concat into one vector, pad to a multiple of k, then
+    ``psum_scatter`` over the intra-tier groups (each of the k intra
+    peers ends holding a distinct 1/k shard, already host-locally
+    reduced), ``psum`` the shard across the inter-tier groups (the only
+    inter-host traffic — 1/k of the payload; ``rest_axes`` fold in here
+    too, on the shard), and ``all_gather`` the fully-reduced shards back
+    over the intra tier. Returns leaves in input order/shape."""
+    gi, ge = hierarchy_groups(k_intra, k_inter)
+    shapes = [np.shape(p) for p in parts]
+    sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]
+    flat = [p.reshape(-1) for p in parts]
+    vec = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+    total = int(vec.shape[0])
+    pad = (-total) % k_intra
+    if pad:
+        vec = jnp.pad(vec, (0, pad))
+    shard = lax.psum_scatter(vec, "data", scatter_dimension=0, tiled=True,
+                             axis_index_groups=gi)
+    shard = lax.psum(shard, "data", axis_index_groups=ge)
+    if rest_axes:
+        shard = lax.psum(shard, tuple(rest_axes))
+    full = lax.all_gather(shard, "data", axis=0, tiled=True,
+                          axis_index_groups=gi)
+    if pad:
+        full = full[:total]
+    out, off = [], 0
+    for shape, n in zip(shapes, sizes):
+        out.append(full[off:off + n].reshape(shape))
+        off += n
+    return out
 
 
 def _exchange_bucket(leaves, specs, out_specs=None, compress=None,
-                     reduce_axes=BATCH_AXES):
+                     reduce_axes=BATCH_AXES, hierarchy=None, data_size=0):
     """One bucket's gradient exchange: replicated leaves ride a single
     tuple-psum over the bucket's reduce-axis set (``reduce_axes`` — the
     batch axes, plus the shaping axes the leaves replicate over on
@@ -465,10 +699,19 @@ def _exchange_bucket(leaves, specs, out_specs=None, compress=None,
     after — the wire carries half the bytes; every f32 accumulation
     around the exchange (local grads, the optimizer) is untouched. The
     cast is per-leaf, so it commutes with bucketing: many-vs-one-bucket
-    stays bit-identical under compression."""
+    stays bit-identical under compression.
+
+    ``hierarchy``/``data_size`` (comm.hierarchy, module docstring): when
+    the bucket reduces over ``data`` and the k | data_size factorization
+    is non-trivial, the flat data-axis psums restage through
+    :func:`_hier_reduce` — replicated leaves as one staged block, fsdp-
+    scattered remainders as a second staged block after their scatters.
+    zero1 leaves keep their flat data scatter (they already move 1/N).
+    The issue order mirrors :func:`_bucket_plan_ops` op for op."""
     if out_specs is None:
         out_specs = specs
     reduce_axes = tuple(reduce_axes)
+    hier = _resolve_hier(hierarchy, data_size, reduce_axes)
     in_dt = leaves[0].dtype if leaves else jnp.float32
     if compress is not None:
         cdt = COMPRESS_DTYPES[compress]
@@ -478,10 +721,18 @@ def _exchange_bucket(leaves, specs, out_specs=None, compress=None,
                if _fsdp_dim(s) is None and z1_dims[i] is None]
     out: List[Any] = [None] * len(leaves)
     if rep_idx:
-        summed = lax.psum(tuple(leaves[i] for i in rep_idx), reduce_axes)
-        for i, v in zip(rep_idx, summed):
+        if hier is not None:
+            reduced = _hier_reduce(
+                [leaves[i] for i in rep_idx], hier[0], hier[1],
+                tuple(a for a in reduce_axes if a != "data"))
+        else:
+            reduced = lax.psum(tuple(leaves[i] for i in rep_idx),
+                               reduce_axes)
+        for i, v in zip(rep_idx, reduced):
             out[i] = v
     rem_axes = tuple(a for a in reduce_axes if a != "fsdp")
+    staged_idx: List[int] = []
+    staged_vals: List[Any] = []
     for i, (leaf, spec) in enumerate(zip(leaves, specs)):
         d = _fsdp_dim(spec)
         dz = z1_dims[i]
@@ -499,9 +750,18 @@ def _exchange_bucket(leaves, specs, out_specs=None, compress=None,
                                     tiled=True)
             if d is None:
                 leaf = lax.psum(leaf, "fsdp")
+        elif hier is not None:
+            staged_idx.append(i)
+            staged_vals.append(leaf)
+            continue
         else:
             leaf = lax.psum(leaf, rem_axes)
         out[i] = leaf
+    if staged_idx:
+        reduced = _hier_reduce(staged_vals, hier[0], hier[1],
+                               tuple(a for a in rem_axes if a != "data"))
+        for i, v in zip(staged_idx, reduced):
+            out[i] = v
     if compress is not None:
         # f32 re-materialization: everything downstream of the exchange
         # (grad-norm metric, optimizer update) accumulates full-precision
@@ -724,14 +984,33 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
             # bytes either way — compression narrows the wire format on
             # the same plan, so A/B rows compare like for like
             if plan.compress is not None:
-                ratio = np.dtype(COMPRESS_DTYPES[plan.compress]).itemsize \
-                    / np.dtype(np.float32).itemsize
+                wire_itemsize = int(
+                    np.dtype(COMPRESS_DTYPES[plan.compress]).itemsize)
+                ratio = wire_itemsize / np.dtype(np.float32).itemsize
                 wire_sizes = [int(b * ratio) for b in bucket_sizes]
             else:
+                wire_itemsize = int(np.dtype(np.float32).itemsize)
                 wire_sizes = bucket_sizes
+            data_size = int(mesh.shape.get("data", 1))
+            leaf_elems = [int(np.prod(np.shape(g), dtype=np.int64))
+                          for g in leaves]
+            plan_ops = [_bucket_plan_ops(
+                [spec_leaves[i] for i in b], [z1_leaves[i] for i in b],
+                reduce_axes=axes, hierarchy=plan.hierarchy,
+                data_size=data_size,
+                leaf_elems=[leaf_elems[i] for i in b],
+                wire_itemsize=wire_itemsize,
+                fsdp_size=int(mesh.shape.get("fsdp", 1)))
+                for axes, b in buckets]
+            # declared sigs go through the module-level wrapper, NOT the
+            # plan_ops list above: declared_bucket_collectives is the
+            # drift seam hangcheck's seeded-mismatch test patches, and a
+            # plan that bypassed it could never be caught disagreeing
+            # with the trace.
             declared = [declared_bucket_collectives(
                 [spec_leaves[i] for i in b], [z1_leaves[i] for i in b],
-                reduce_axes=axes)
+                reduce_axes=axes, hierarchy=plan.hierarchy,
+                data_size=data_size)
                 for axes, b in buckets]
             overlap_stats.record(plan.bucket_bytes, bucket_sizes,
                                  [len(b) for _, b in buckets],
@@ -740,7 +1019,15 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                                  wire_bytes=wire_sizes,
                                  declared=declared,
                                  reduce_axes=[axes for axes, _ in buckets],
-                                 accum_steps=accum)
+                                 accum_steps=accum,
+                                 hierarchy=plan.hierarchy,
+                                 autotune=plan.autotune, tuned=plan.tuned,
+                                 inter_wire=[sum(op["wire_bytes"]
+                                                 for op in ops
+                                                 if op["inter"])
+                                             for ops in plan_ops],
+                                 op_wire=[[op["wire_bytes"] for op in ops]
+                                          for ops in plan_ops])
             out_leaves: List[Any] = [None] * len(leaves)
             anchor = None
             for bi, ((axes, b), nbytes, wbytes) in enumerate(
@@ -757,7 +1044,8 @@ def make_bucketed_grad(plan: OverlapPlan, mesh: Mesh, *,
                     exchanged = _exchange_bucket(
                         vals, [spec_leaves[i] for i in b],
                         out_specs=[z1_leaves[i] for i in b],
-                        compress=plan.compress, reduce_axes=axes)
+                        compress=plan.compress, reduce_axes=axes,
+                        hierarchy=plan.hierarchy, data_size=data_size)
                     anchor = exchanged[0]
                     for i, v in zip(b, exchanged):
                         out_leaves[i] = v
@@ -900,7 +1188,8 @@ def make_bucketed_gather(plan: OverlapPlan, mesh: Mesh,
     return gather
 
 
-def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
+def probe_comm_plan(mesh: Mesh, reps: int = 3,
+                    hier_k: Optional[int] = None) -> Optional[dict]:
     """Measure each planned exchange bucket's collective STANDALONE on the
     live mesh — the runtime leg of per-collective attribution
     (docs/observability.md; the static leg is the committed
@@ -932,7 +1221,16 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
     the mesh is already broken and the watchdog owns recovery. Results land in
     ``utils.metrics.comm_timing_stats``; returns the recorded snapshot,
     or None when no plan has traced / the probe was abandoned. Never
-    raises (observability must not kill training)."""
+    raises (observability must not kill training).
+
+    ``hier_k`` (the intra-tier size of a data-axis factorization —
+    comm.hierarchy / the autotune pass): additionally times, per
+    data-reducing axis set, one grouped psum over the INTRA tier (full
+    payload = that set's largest bucket wire) and one over the INTER
+    tier (1/k payload — the staged plan's cross-host leg). These land as
+    ``tiers`` entries in the comm_timing row and fold into the bandwidth
+    catalog as ``<axes>:intra`` / ``<axes>:inter`` rows — what
+    tune_comm_plan ranks flat-vs-hierarchical with."""
     import math
     import time as _time
 
@@ -960,6 +1258,7 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
 
     # -- phase 1: LOCAL prep (deterministic; no collective issued) -------
     programs = []
+    tier_programs = []
     agree_c = None
     ok = 1.0
     try:
@@ -987,6 +1286,44 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
                            out_shardings=replicated).lower().compile()
             programs.append((bi, int(nbytes), int(wbytes), int(leaves),
                              baxes, fn, fill))
+
+        # tier legs (hierarchical autotune): per data-reducing axis set,
+        # a grouped intra-tier psum at the set's max bucket wire and a
+        # grouped inter-tier psum at 1/k of it. Grouped psums of a
+        # replicated input are replica-consistent (equal group sizes),
+        # so P()→P() is sound.
+        dsize = int(mesh.shape.get("data", 1))
+        if hier_k and 1 < int(hier_k) < dsize and dsize % int(hier_k) == 0:
+            gi, ge = hierarchy_groups(int(hier_k), dsize // int(hier_k))
+            sig_payload: dict = {}
+            for wbytes, baxes in zip(snap["bucket_wire_bytes"],
+                                     bucket_axes):
+                if "data" in baxes:
+                    s = "+".join(baxes)
+                    sig_payload[s] = max(sig_payload.get(s, 0),
+                                         int(wbytes))
+            for sig in sorted(sig_payload):
+                for tier, groups, tbytes in (
+                        ("intra", gi, sig_payload[sig]),
+                        ("inter", ge,
+                         max(1, sig_payload[sig] // int(hier_k)))):
+                    elems = max(1, int(tbytes) // wire_dtype.itemsize)
+
+                    def _gpsum(x, _g=groups):
+                        return lax.psum(x, "data", axis_index_groups=_g)
+
+                    fn = jax.jit(shard_map_compat(
+                        _gpsum, mesh, in_specs=P(),
+                        out_specs=P())).lower(
+                            jax.ShapeDtypeStruct((elems,), wire_dtype,
+                                                 sharding=replicated)
+                        ).compile()
+                    fill = jax.jit(
+                        lambda e=elems: jnp.zeros((e,), wire_dtype),
+                        out_shardings=replicated).lower().compile()
+                    tier_programs.append(
+                        (sig, tier, elems * wire_dtype.itemsize, fn,
+                         fill))
     except Exception:  # pragma: no cover - prep is best effort
         log.exception("comm-plan probe prep failed; voting to abandon")
         ok = 0.0
@@ -1010,6 +1347,7 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
 
     # -- phase 3: the timed collectives (all processes committed) --------
     buckets = []
+    tiers = []
     total = 0.0
     try:
         for bi, nbytes, wbytes, leaves, baxes, fn, fill in programs:
@@ -1035,11 +1373,32 @@ def probe_comm_plan(mesh: Mesh, reps: int = 3) -> Optional[dict]:
                 "wire_bytes_per_sec": round(wbytes / best, 1)
                 if best > 0 else 0.0,
             })
+        # tier legs last: same timing discipline, but their times do NOT
+        # join comm_secs_total — they measure hypothetical staged legs,
+        # not the plan's standalone exchange cost
+        for sig, tier, tbytes, fn, fill in tier_programs:
+            x = fill()
+            jax.block_until_ready(fn(x))
+            best = None
+            for _ in range(max(1, reps)):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(fn(x))
+                dt = _time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            tiers.append({
+                "axes": sig,
+                "tier": tier,
+                "wire_bytes": int(tbytes),
+                "probe_secs": round(best, 6),
+                "wire_bytes_per_sec": round(tbytes / best, 1)
+                if best > 0 else 0.0,
+            })
     except Exception:  # pragma: no cover - the mesh is already broken
         log.exception("comm-plan probe failed mid-measurement; "
                       "comm_timing row will be absent")
         return None
-    comm_timing_stats.record(buckets, total, max(1, reps), axes, compress)
+    comm_timing_stats.record(buckets, total, max(1, reps), axes, compress,
+                             tiers=tiers)
     log.info("comm probe: %d bucket(s), %.2f ms standalone exchange "
              "(compress=%s)", len(buckets), total * 1e3, compress)
     result = comm_timing_stats.snapshot()

@@ -14,10 +14,10 @@ device kind × global device count (``fabric_id``) — a v4-32's ICI numbers
 must never cost a v5e-8 plan, and the virtual-8 CPU mesh the tests/gate
 run on gets its own file.
 
-Catalog schema (``schema_version`` 1, documented in docs/planner.md)::
+Catalog schema (``schema_version`` 2, documented in docs/planner.md)::
 
     {
-     "schema_version": 1,
+     "schema_version": 2,
      "fabric": "cpu-8",            # fabric_id() of the measuring run
      "platform": "cpu",
      "device_kind": "cpu",
@@ -29,9 +29,17 @@ Catalog schema (``schema_version`` 1, documented in docs/planner.md)::
        "samples": 12,              # probe buckets folded in, ever
        "min_wire_bytes": 20480,    # payload range the numbers came from
        "max_wire_bytes": 4194304
-      }, ...
-     }
+      },
+      "data+fsdp:intra": {         # hierarchical tier rows (v2): the
+       "tier": "intra",            # probe's grouped-psum legs over the
+       ...                         # fast intra-host / slow inter-host
+      }, ...                       # sub-groups of the data axis — what
+     }                             # tune_comm_plan ranks hierarchy with
     }
+
+v1 documents (no tier rows, no ``tier`` field) load unchanged — every
+v1 key is a valid v2 flat key; the first probe fold on a factored mesh
+adds the tier rows and stamps the current schema_version.
 
 Merging is best-achieved: ``bytes_per_sec`` only ratchets up and
 ``latency_secs`` only down — the probe times collectives standalone
@@ -50,7 +58,7 @@ from typing import Dict, List, Optional
 
 log = logging.getLogger(__name__)
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 #: env override for the catalog directory (tests point it at a tmpdir;
 #: multi-user clusters point it at a shared results tree)
@@ -116,11 +124,20 @@ def lookup(catalog: Optional[dict], axes_sig: str) -> Optional[dict]:
     entry = axes.get(axes_sig)
     if entry is not None:
         return entry
-    want = set(axes_sig.split("+"))
+    base, _, tier = axes_sig.partition(":")
+    if tier:
+        # tiered query without a tiered row: the flat row for the same
+        # axis set is the honest stand-in
+        entry = axes.get(base)
+        if entry is not None:
+            return entry
+    want = set(base.split("+"))
     best = None
     for name in sorted(axes):
-        overlap = len(want & set(name.split("+")))
-        key = (overlap, axes[name].get("samples", 0))
+        nbase, _, ntier = name.partition(":")
+        overlap = len(want & set(nbase.split("+")))
+        key = (overlap, 1 if ntier == tier else 0,
+               axes[name].get("samples", 0))
         if best is None or key > best[0]:
             best = (key, axes[name])
     return best[1] if best else None
@@ -150,25 +167,41 @@ def update_from_probe(snapshot: Optional[dict],
             "devices": len(devices),
             "axes": {},
         }
+        # folding under the current schema: v1 docs carry only flat keys,
+        # all valid under v2 — stamp the version forward on write
+        doc["schema_version"] = SCHEMA_VERSION
         axes: Dict[str, dict] = doc.setdefault("axes", {})
-        for b in snapshot["buckets"]:
-            sig = b.get("axes") or "data"
-            wire = int(b.get("wire_bytes", 0))
-            bw = float(b.get("wire_bytes_per_sec", 0.0))
-            secs = float(b.get("probe_secs", 0.0))
+
+        def fold(sig, wire, bw, secs, tier=None):
             if wire <= 0 or bw <= 0 or secs <= 0:
-                continue
+                return
             e = axes.get(sig)
             if e is None:
-                axes[sig] = {"bytes_per_sec": bw, "latency_secs": secs,
-                             "samples": 1, "min_wire_bytes": wire,
-                             "max_wire_bytes": wire}
+                e = axes[sig] = {"bytes_per_sec": bw,
+                                 "latency_secs": secs,
+                                 "samples": 1, "min_wire_bytes": wire,
+                                 "max_wire_bytes": wire}
             else:
                 e["bytes_per_sec"] = max(float(e["bytes_per_sec"]), bw)
                 e["latency_secs"] = min(float(e["latency_secs"]), secs)
                 e["samples"] = int(e.get("samples", 0)) + 1
                 e["min_wire_bytes"] = min(int(e["min_wire_bytes"]), wire)
                 e["max_wire_bytes"] = max(int(e["max_wire_bytes"]), wire)
+            if tier:
+                e["tier"] = tier
+
+        for b in snapshot["buckets"]:
+            fold(b.get("axes") or "data", int(b.get("wire_bytes", 0)),
+                 float(b.get("wire_bytes_per_sec", 0.0)),
+                 float(b.get("probe_secs", 0.0)))
+        # hierarchical tier legs (probe hier_k) land under tiered keys
+        # ("<axes>:intra" / "<axes>:inter") with an explicit tier field
+        for t in snapshot.get("tiers") or []:
+            tier = t.get("tier", "intra")
+            fold(f"{t.get('axes') or 'data'}:{tier}",
+                 int(t.get("wire_bytes", 0)),
+                 float(t.get("wire_bytes_per_sec", 0.0)),
+                 float(t.get("probe_secs", 0.0)), tier=tier)
         if not axes:
             return None
         os.makedirs(os.path.dirname(path), exist_ok=True)

@@ -44,6 +44,11 @@ log = logging.getLogger(__name__)
 #: schedule ops that can carry a gradient-exchange bucket's payload
 _EXCHANGE_OPS = ("psum", "psum_scatter")
 
+#: staged (hierarchical) plans additionally issue an intra-tier
+#: all-gather; admitted only when the signature carries the per-op wire
+#: ledger (a forward fsdp all-gather must never steal a flat match)
+_EXCHANGE_OPS_HIER = _EXCHANGE_OPS + ("all_gather",)
+
 
 def default_schedule_path() -> str:
     from .. import analysis
@@ -79,19 +84,32 @@ def _match_buckets(buckets: List[dict],
     """In-order subsequence match of the measured buckets' wire bytes
     against the schedule's exchange-capable ops (the same matching
     discipline analysis/collectives.py uses for the declared plan).
-    Returns (matched count, buckets annotated with static kind/axes)."""
+    Returns (matched count, buckets annotated with static kind/axes).
+
+    Hierarchical signatures (a ``plan.bucket_op_wire_bytes`` ledger):
+    the measured bucket's probe payload is the bucket WIRE bytes, but
+    the staged trace opens with a reduce-scatter whose input is the
+    padded payload — so each bucket matches against its ledger's FIRST
+    op bytes instead, and the staged all-gather joins the admissible op
+    set."""
     annotated = [dict(b) for b in buckets]
     if not signature:
         return 0, annotated
+    plan = signature.get("plan") or {}
+    op_wire = plan.get("bucket_op_wire_bytes")
+    exchange_ops = _EXCHANGE_OPS_HIER if op_wire else _EXCHANGE_OPS
     ops = _expanded_ops(signature)
     cursor = 0
     matched = 0
-    for b in annotated:
+    for j, b in enumerate(annotated):
+        want = int(b["wire_bytes"])
+        if op_wire and j < len(op_wire) and op_wire[j]:
+            want = int(op_wire[j][0])
         hit = None
         for i in range(cursor, len(ops)):
             op = ops[i]
-            if op.get("op") in _EXCHANGE_OPS and \
-                    int(op.get("bytes", -1)) == int(b["wire_bytes"]):
+            if op.get("op") in exchange_ops and \
+                    int(op.get("bytes", -1)) == want:
                 hit = i
                 break
         if hit is None:
@@ -102,6 +120,9 @@ def _match_buckets(buckets: List[dict],
         b["static"] = {"kind": ops[hit].get("op"),
                        "axes": ops[hit].get("axes"),
                        "operands": ops[hit].get("operands")}
+        if ops[hit].get("tier"):
+            b["static"]["tier"] = ops[hit]["tier"]
+            b["static"]["groups"] = ops[hit].get("groups")
     return matched, annotated
 
 

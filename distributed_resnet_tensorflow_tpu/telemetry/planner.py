@@ -78,10 +78,24 @@ ACT_FLOPS_PER_BYTE = 50.0
 #: (same set main.py comm-report matches on)
 _EXCHANGE_OPS = ("psum", "psum_scatter")
 
+#: staged (hierarchical) plans additionally issue an intra-tier
+#: all-gather leg; only op-wire-ledger matching admits it (a forward
+#: fsdp all-gather must never steal a flat bucket match)
+_EXCHANGE_OPS_HIER = _EXCHANGE_OPS + ("all_gather",)
+
 #: variants of the committed schedule the planner costs as knob
 #: candidates (serve_* and reshard_* variants are not train steps)
 PLAN_VARIANTS = ("train", "overlap", "overlap+zero1", "overlap+accum2",
-                 "overlap+accum4", "bf16+compress")
+                 "overlap+accum4", "overlap+hier", "bf16+compress")
+
+#: bucket_mb candidates the startup autotune pass costs (the configured
+#: value always joins the set)
+TUNE_BUCKET_MB = (0.25, 1.0, 4.0, 16.0)
+
+#: a probed tier bandwidth this many × the flat row's is a measurement
+#: lie (the seeded-probe-lie tests): the tuner then distrusts the tier
+#: rows and falls back to the flat plan, loudly
+TUNE_SANITY_FACTOR = 100.0
 
 
 def layout_label(mesh_cfg) -> str:
@@ -161,23 +175,47 @@ class BandwidthTable:
             old = by_sig.get(sig)
             by_sig[sig] = (max(bps, old[0]) if old else bps,
                            min(lat, old[1]) if old else lat)
+        # hierarchical tier legs (probe hier_k) land under the catalog's
+        # tiered key form — "<axes>:intra" / "<axes>:inter"
+        for t in snapshot.get("tiers") or []:
+            bps = float(t.get("wire_bytes_per_sec", 0.0))
+            lat = float(t.get("probe_secs", 0.0))
+            if bps <= 0:
+                continue
+            sig = f"{t.get('axes') or 'data'}:{t.get('tier', 'intra')}"
+            old = by_sig.get(sig)
+            by_sig[sig] = (max(bps, old[0]) if old else bps,
+                           min(lat, old[1]) if old else lat)
         if not by_sig:
             return None
         t = cls("probe", by_sig)
-        t.default_bps = max(v[0] for v in by_sig.values())
-        t.default_latency = min(v[1] for v in by_sig.values())
+        # defaults from the FLAT rows when any exist: a tier row's
+        # bandwidth describes a sub-group, not an unknown full axis set
+        flat = {k: v for k, v in by_sig.items() if ":" not in k} or by_sig
+        t.default_bps = max(v[0] for v in flat.values())
+        t.default_latency = min(v[1] for v in flat.values())
         return t
 
     def lookup(self, axes_sig: str) -> Tuple[float, float]:
         hit = self.axes.get(axes_sig)
         if hit is not None:
             return hit
-        # nearest axis set (most shared names; deterministic tie-break)
-        want = set(axes_sig.split("+"))
+        base, _, tier = axes_sig.partition(":")
+        if tier:
+            # tiered query, no tiered row: the flat row for the same axis
+            # set is the honest stand-in (same wire, no tier split)
+            hit = self.axes.get(base)
+            if hit is not None:
+                return hit
+        # nearest axis set (most shared names; matching tier preferred;
+        # deterministic tie-break)
+        want = set(base.split("+"))
         best = None
         for name in sorted(self.axes):
-            score = len(want & set(name.split("+")))
-            if score and (best is None or score > best[0]):
+            nbase, _, ntier = name.partition(":")
+            score = (len(want & set(nbase.split("+"))),
+                     1 if ntier == tier else 0)
+            if score[0] and (best is None or score > best[0]):
                 best = (score, self.axes[name])
         return best[1] if best else (self.default_bps,
                                      self.default_latency)
@@ -244,7 +282,16 @@ def predict_from_signature(signature: dict, bandwidth: BandwidthTable,
     device count other than the canonical 8 the schedule traced at),
     overlap credit for the declared bucket plan's exchange ops."""
     plan = signature.get("plan") or {}
-    bucket_wire = [int(b) for b in plan.get("bucket_wire_bytes") or []]
+    # staged (hierarchical) plans carry the per-op wire ledger, aligned
+    # 1:1 with the declared RS→psum→AG sequence — match op-by-op against
+    # it; flat plans keep the one-op-per-bucket match
+    op_wire = plan.get("bucket_op_wire_bytes")
+    if op_wire:
+        match_wire = [int(x) for b in op_wire for x in b]
+        exchange_ops = _EXCHANGE_OPS_HIER
+    else:
+        match_wire = [int(b) for b in plan.get("bucket_wire_bytes") or []]
+        exchange_ops = _EXCHANGE_OPS
     scale = _ring_scale(devices) / _ring_scale(8)
     comm_secs = 0.0
     exchange_secs = 0.0
@@ -252,15 +299,20 @@ def predict_from_signature(signature: dict, bandwidth: BandwidthTable,
     cursor = 0
     for op in _expanded_ops(signature):
         nbytes = int(op.get("bytes", 0)) * scale
-        bps, lat = bandwidth.lookup("+".join(op.get("axes") or []))
+        sig = "+".join(op.get("axes") or [])
+        if op.get("tier"):
+            # grouped (two-tier) collectives cost against the tiered
+            # bandwidth row ("data+fsdp:intra" / ":inter")
+            sig = f"{sig}:{op['tier']}"
+        bps, lat = bandwidth.lookup(sig)
         secs = lat + nbytes / bps
         comm_secs += secs
         wire_bytes += int(nbytes)
         # in-order subsequence match against the bucket plan (the
         # comm-report discipline): matched ops are the overlappable
         # gradient exchange
-        if op.get("op") in _EXCHANGE_OPS and cursor < len(bucket_wire) \
-                and int(op.get("bytes", -1)) == bucket_wire[cursor]:
+        if op.get("op") in exchange_ops and cursor < len(match_wire) \
+                and int(op.get("bytes", -1)) == match_wire[cursor]:
             cursor += 1
             exchange_secs += secs
     exposed = (comm_secs - exchange_secs) \
@@ -273,6 +325,113 @@ def predict_from_signature(signature: dict, bandwidth: BandwidthTable,
         "comm_exposed_secs": exposed,
         "comm_fraction": exposed / step_secs if step_secs > 0 else 0.0,
         "wire_bytes": wire_bytes,
+    }
+
+
+def tune_comm_plan(snapshot: dict, table: BandwidthTable, *,
+                   intra_k: Optional[int],
+                   bucket_mb: float,
+                   bucket_mb_candidates=TUNE_BUCKET_MB) -> dict:
+    """The startup autotune's chooser (comm.autotune=startup): given the
+    traced plan snapshot (parallel/overlap.overlap_stats — grad bytes,
+    per-bucket reduce-axis sets, the configured compress) and a
+    bandwidth table (ideally carrying the probe's tiered rows), cost
+    every (bucket_mb × flat-vs-hierarchical × compress) candidate with
+    the planner's collective model and return the cheapest. Pure and
+    deterministic given its inputs — the autotune-determinism contract
+    the tests pin.
+
+    First-order model, documented in docs/planner.md: the gradient is
+    one payload on its DOMINANT reduce-axis set (the set carrying the
+    most bucket bytes); a flat bucket costs ``lat + W/bps``; a staged
+    bucket costs the RS and AG legs on the intra tier plus the 1/k psum
+    on the inter tier. Compression candidates never introduce a lossy
+    wire dtype the operator didn't configure — options are "off" and
+    the snapshot's own compress.
+
+    Fallback discipline (the seeded-probe-lie tests): hierarchical
+    candidates are only costed when the table carries MEASURED tier rows
+    for the dominant set, and those rows pass the TUNE_SANITY_FACTOR
+    plausibility screen against the flat row — otherwise the tuner
+    stays flat and logs the reason loudly. Returns {bucket_mb,
+    hierarchy (k or 0), compress, predicted_secs, axes, source,
+    candidates, fallback}."""
+    grad_bytes = int(snapshot.get("grad_bytes") or 0)
+    sigs = snapshot.get("bucket_reduce_axes") or ["data+fsdp"]
+    sizes = snapshot.get("bucket_bytes") or [grad_bytes]
+    by_sig: Dict[str, int] = {}
+    for sig, nb in zip(sigs, sizes):
+        by_sig[sig] = by_sig.get(sig, 0) + int(nb)
+    # dominant reduce-axis set: most bytes, lexicographic tie-break
+    sig = sorted(by_sig, key=lambda s: (-by_sig[s], s))[0]
+    cur_compress = snapshot.get("compress", "off") or "off"
+    compress_opts = ["off"] if cur_compress == "off" \
+        else ["off", cur_compress]
+    itemsize = {"off": 4, "bf16": 2, "fp16": 2}
+
+    fallback = None
+    k = int(intra_k) if intra_k else 0
+    if k > 1 and "data" not in sig.split("+"):
+        k, fallback = 0, ("dominant reduce set %r has no data axis" % sig)
+    bps_f, lat_f = table.lookup(sig)
+    if k > 1:
+        if f"{sig}:intra" not in table.axes \
+                or f"{sig}:inter" not in table.axes:
+            k, fallback = 0, (
+                f"no measured tier rows for {sig!r} in the "
+                f"{table.source} table")
+        else:
+            bps_i, lat_i = table.lookup(f"{sig}:intra")
+            bps_e, lat_e = table.lookup(f"{sig}:inter")
+            implausible = [
+                f"{t}={bps:.3g} B/s vs flat {bps_f:.3g} B/s"
+                for t, bps in (("intra", bps_i), ("inter", bps_e))
+                if not (0 < bps <= TUNE_SANITY_FACTOR * bps_f)]
+            if implausible:
+                k, fallback = 0, (
+                    "tier bandwidth rows fail the plausibility screen "
+                    f"(×{TUNE_SANITY_FACTOR:g} of the flat row): "
+                    + "; ".join(implausible))
+    if fallback:
+        log.warning("comm autotune: hierarchical candidates DISABLED — "
+                    "%s; tuning flat only", fallback)
+
+    def cost(mb: float, hier: int, compress: str) -> float:
+        cap = max(1, int(mb * 2 ** 20))
+        n = max(1, -(-grad_bytes // cap))  # ceil
+        w = (grad_bytes / n) * itemsize[compress] / 4.0
+        if hier:
+            return n * (2 * (lat_i + w / bps_i)
+                        + (lat_e + (w / hier) / bps_e))
+        return n * (lat_f + w / bps_f)
+
+    mbs = sorted(set(float(m) for m in bucket_mb_candidates)
+                 | {float(bucket_mb)})
+    scored = []
+    for mb in mbs:
+        for hier in ([0, k] if k > 1 else [0]):
+            for compress in compress_opts:
+                scored.append((round(cost(mb, hier, compress), 9),
+                               mb != float(bucket_mb), hier == 0,
+                               mb, hier, compress))
+    # cheapest wins; ties prefer the configured bucket_mb, then the
+    # hierarchical form (it was only admitted with measured tier rows),
+    # then the smaller cap / plainer wire — fully deterministic
+    scored.sort(key=lambda t: (t[0], t[1], t[2], t[3], t[5]))
+    best = scored[0]
+    return {
+        "bucket_mb": best[3],
+        "hierarchy": best[4],
+        "compress": best[5],
+        "predicted_secs": best[0],
+        "axes": sig,
+        "source": table.source,
+        "fallback": fallback,
+        "candidates": {
+            f"bucket{mb:g}mb/"
+            + (f"hier{hier}" if hier else "flat")
+            + (f"/{compress}" if compress != "off" else ""):
+            secs for secs, _, _, mb, hier, compress in scored},
     }
 
 
@@ -362,6 +521,7 @@ def _variant_knobs(cfg, variant: str) -> dict:
         "bucket_mb": cfg.comm.bucket_mb,
         "accum": accum,
         "overlap": variant != "train",
+        "hierarchy": "hier" in variant,
     }
 
 

@@ -376,6 +376,9 @@ class CommOverlapHook(_SnapshotExportHook):
             # per bucket) and not in EVENT_SCHEMAS["comm_overlap"]: the
             # schedule cross-check reads it straight off overlap_stats
             snap.pop("declared_collectives", None)
+            # same contract: per-op wire bytes mirror the declared
+            # sequence 1:1 — planner/comm-report inputs, not a row field
+            snap.pop("bucket_op_wire_bytes", None)
         return snap
 
 

@@ -385,6 +385,32 @@ class Trainer:
                 "(parallel/overlap.py), so this run exchanges FULL f32 "
                 "payloads — enable comm.overlap (or accept the "
                 "uncompressed exchange)", cfg.comm.compress)
+        # the hierarchical exchange and the startup autotune pass ride
+        # the same exchange — validate their knobs even when overlap is
+        # off, and warn loudly when they were requested but cannot act
+        # (the compress-warning contract above)
+        from ..parallel.overlap import autotune_mode, resolve_hierarchy
+        self._autotune = autotune_mode(cfg)
+        if self._overlap is None:
+            resolve_hierarchy(cfg, self.mesh)  # validate / raise on =on
+            if cfg.comm.hierarchy != "off" or self._autotune != "off":
+                import logging
+                logging.getLogger(__name__).warning(
+                    "comm.hierarchy=%s / comm.autotune=%s with "
+                    "comm.overlap resolved OFF: both ride the bucketed "
+                    "exchange (parallel/overlap.py), so neither can act "
+                    "— enable comm.overlap",
+                    cfg.comm.hierarchy, cfg.comm.autotune)
+        elif self._autotune == "startup" and not cfg.telemetry.comm_timing:
+            import logging
+            logging.getLogger(__name__).warning(
+                "comm.autotune=startup without telemetry.comm_timing: "
+                "the startup pass tunes FROM the comm probe's "
+                "measurements (parallel/overlap.probe_comm_plan) — "
+                "autotune degrades to off", )
+            self._autotune = "off"
+        self._comm_tuned = False
+        self._comm_retuned = False
         # ZeRO-1 sharded weight update (arXiv:2004.13336; parallel/
         # sharding.py rule table): optimizer state shards over `data`,
         # gradients reduce-scatter into the shard layout, the update runs
@@ -1016,11 +1042,85 @@ class Trainer:
         if self._comm_probed or not self.comm_overlap_active \
                 or not self.cfg.telemetry.comm_timing:
             return
-        from ..parallel.overlap import overlap_stats, probe_comm_plan
+        from ..parallel.overlap import (hierarchy_factor, overlap_stats,
+                                        probe_comm_plan)
         if overlap_stats.snapshot() is None:
             return  # the step has not traced yet
         self._comm_probed = True
-        probe_comm_plan(self.mesh, reps=self.cfg.telemetry.comm_timing_reps)
+        # the tier legs probe whenever the mesh factors — a flat plan
+        # still measures intra/inter bandwidth so the autotune pass (and
+        # the offline planner, via the catalog) can rank hierarchy
+        hier_k = self._overlap.hierarchy
+        if hier_k is None and self._autotune == "startup":
+            try:
+                hier_k = hierarchy_factor(self.cfg, self.mesh)
+            except ValueError:
+                hier_k = None
+        result = probe_comm_plan(self.mesh,
+                                 reps=self.cfg.telemetry.comm_timing_reps,
+                                 hier_k=hier_k)
+        if result is not None and self._autotune == "startup" \
+                and not self._comm_tuned:
+            self._comm_tuned = True
+            self._retune_comm(result, hier_k)
+
+    def _retune_comm(self, probe_result: dict,
+                     hier_k: Optional[int]) -> None:
+        """The startup autotune pass (comm.autotune=startup): feed the
+        probe's measurements into the planner's cost model
+        (telemetry/planner.tune_comm_plan), and when the chosen plan
+        differs from the running one, REBUILD the train step around it —
+        the tuned plan re-traces, re-records its declared schedule, and
+        the next ``_maybe_probe_comm`` boundary re-probes it (guarded by
+        ``_comm_tuned`` against a tune loop). Never raises: a failed
+        tune keeps the configured plan."""
+        import logging
+        log = logging.getLogger(__name__)
+        try:
+            from ..parallel.overlap import overlap_stats
+            from ..telemetry.planner import BandwidthTable, tune_comm_plan
+            snap = overlap_stats.snapshot()
+            if snap is None:
+                return
+            table = BandwidthTable.from_probe(probe_result)
+            choice = tune_comm_plan(
+                snap, table,
+                intra_k=hier_k,
+                bucket_mb=self.cfg.comm.bucket_mb)
+        except Exception:
+            log.exception("comm autotune failed; keeping the configured "
+                          "plan")
+            return
+        plan = self._overlap
+        import dataclasses as _dc
+        tuned = _dc.replace(
+            plan,
+            bucket_bytes=int(choice["bucket_mb"] * 2 ** 20),
+            compress=None if choice["compress"] == "off"
+            else choice["compress"],
+            hierarchy=choice["hierarchy"] or None,
+            tuned=True)
+        log.info("comm autotune (startup): chose bucket_mb=%s compress=%s "
+                 "hierarchy=%s (%s)", choice["bucket_mb"],
+                 choice["compress"], choice["hierarchy"] or "flat",
+                 choice.get("fallback") or "cost model")
+        changed = (tuned.bucket_bytes, tuned.compress, tuned.hierarchy) \
+            != (plan.bucket_bytes, plan.compress, plan.hierarchy)
+        # rebuild even on a no-change choice: the re-traced plan records
+        # tuned=True into overlap_stats, so the comm_overlap row and the
+        # schedule artifact show the plan was CHOSEN, not just configured
+        self._overlap = tuned
+        self._train_step = self._build_train_step(self._aug_fn)
+        self._jitted_train = None
+        self._jitted_multi = None
+        self._jitted_idx = None
+        self._jitted_idx_multi = None
+        # the hot loops cache the jitted fn in a local — this flag tells
+        # them to re-fetch it so the tuned plan takes over MID-RUN (the
+        # startup pass must tune the very training it probed)
+        self._comm_retuned = True
+        if changed:
+            self._comm_probed = False  # re-probe the tuned plan's buckets
 
     # -- loops -------------------------------------------------------------
     def train(self, data_iter: Iterator, num_steps: Optional[int] = None,
@@ -1123,6 +1223,12 @@ class Trainer:
                 with span("train.step"):
                     self.state, metrics = step_fn(self.state, batch)
                 self._maybe_probe_comm()
+                if self._comm_retuned:
+                    # the startup autotune rebuilt the step around its
+                    # chosen plan — swap the fresh jit in mid-run (the
+                    # accessor is a cached-attribute check afterwards)
+                    step_fn = self.jitted_index_step() if use_idx \
+                        else self.jitted_train_step()
                 for h in hooks:
                     h(step + 1, self.state, metrics)
                 if stop_fn is not None and stop_fn():
@@ -1169,6 +1275,8 @@ class Trainer:
                 with span("train.step"):
                     self.state, metrics = step_fn(self.state, b)
                 self._maybe_probe_comm()
+                if self._comm_retuned:
+                    step_fn = single_fn()  # autotuned rebuild — swap in
                 step += 1
                 for h in hooks:
                     h(step, self.state, metrics)
@@ -1201,6 +1309,10 @@ class Trainer:
                 with span("train.step"):
                     self.state, metrics = multi_fn(self.state, stacked)
                 self._maybe_probe_comm()
+                if self._comm_retuned:
+                    # autotuned rebuild — swap the fused dispatch in too
+                    multi_fn = self.jitted_index_multi_step(k) if use_idx \
+                        else self.jitted_multi_step(k)
                 step += k
                 for h in hooks:
                     h(step, self.state, metrics)

@@ -337,6 +337,32 @@ class CommConfig:
     # compresses (the Trainer warns loudly). Local gradient accumulation
     # and the optimizer update stay f32 either way.
     compress: str = "off"             # off | bf16 | fp16
+    # hierarchical (two-tier) data-axis exchange (arXiv:1811.05233 2D-torus
+    # allreduce; arXiv:1711.04325 intra-node-reduce-then-inter-node): when
+    # the ``data`` mesh axis factors into intra-host × inter-host groups
+    # (host-aware device order, parallel/mesh.py), each bucket is
+    # reduce-scattered over the fast intra-host tier first, psummed as a
+    # 1/k shard over the slow inter-host tier, then all-gathered back
+    # intra-host — inter-host wire bytes drop to 1/intra_k per bucket.
+    # auto = on iff the bucketed exchange is on AND a non-trivial
+    # factorization exists; on = force (raises with the reason when no
+    # factorization exists); off = flat single-tier collectives
+    hierarchy: str = "off"            # off | auto | on
+    # explicit intra-tier group size override: 0 = derive from the mesh's
+    # host layout (jax.process_count / device process indices); a value
+    # k with 1 < k < data_axis_size and k | data_axis_size forces the
+    # factorization — the virtual-8 CPU test path ("2 hosts × 4 devices")
+    intra_axis_size: int = 0
+    # self-tuning comm plan (telemetry/planner.py tune_comm_plan): at the
+    # first step boundary a probe (probe_comm_plan, extended to time flat
+    # vs hierarchical legs per reduce-axis set) feeds the planner's cost
+    # model, which picks bucket_mb, compress (never introducing a lossy
+    # wire dtype the operator didn't opt into) and flat-vs-hierarchical
+    # per axis set; the chosen plan is recorded in the comm_overlap row
+    # and analysis/plan_catalog.json, and the step is rebuilt once.
+    # Requires telemetry.comm_timing (the probe) — startup warns and
+    # degrades to off without it.
+    autotune: str = "off"             # off | startup
 
 
 @dataclass

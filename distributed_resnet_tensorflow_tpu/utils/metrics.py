@@ -244,7 +244,7 @@ class CommTimingStats:
         self._probe: Optional[Dict[str, Any]] = None
 
     def record(self, buckets, comm_secs_total: float, reps: int,
-               axes, compress: str) -> None:
+               axes, compress: str, tiers=None) -> None:
         with self._lock:
             self._probe = {
                 "buckets": [dict(b) for b in buckets],
@@ -252,6 +252,10 @@ class CommTimingStats:
                 "reps": int(reps),
                 "axes": list(axes),
                 "compress": compress,
+                # hierarchical tier legs (probe hier_k): standalone
+                # grouped-psum timings per (axes, intra|inter) — catalog
+                # inputs for tune_comm_plan, NOT part of comm_secs_total
+                "tiers": [dict(t) for t in tiers] if tiers else [],
             }
 
     def reset(self) -> None:
@@ -414,6 +418,17 @@ EVENT_SCHEMAS = {
                                   "by construction (the grouped planner)",
             "accum_steps": "train.grad_accum_steps the exchange "
                            "accumulates over inside the body (1 = none)",
+            "hierarchy": "intra-tier group size k of the two-tier "
+                         "data-axis exchange (comm.hierarchy; 0 = flat)",
+            "autotune": "comm.autotune mode the plan resolved under "
+                        "(off | startup)",
+            "tuned": "true when the startup autotune pass REWROTE the "
+                     "plan (telemetry/planner.tune_comm_plan)",
+            "bucket_inter_wire_bytes": "per-bucket wire bytes crossing "
+                                       "the SLOW (inter-host) data tier "
+                                       "— the full wire payload when "
+                                       "flat, 1/k of it (+pad) when "
+                                       "hierarchical",
         },
     },
     "comm_timing": {
@@ -435,6 +450,12 @@ EVENT_SCHEMAS = {
             "axes": "mesh axes the probed collective reduces over",
             "compress": "wire dtype the probe used (comm.compress; off "
                         "= f32)",
+            "tiers": "hierarchical tier legs, when probed with a "
+                     "factored data axis: {axes, tier: intra|inter, "
+                     "wire_bytes, probe_secs, wire_bytes_per_sec} per "
+                     "data-reducing axis set — catalog inputs for the "
+                     "autotune cost model, NOT included in "
+                     "comm_secs_total",
             "step_secs": "measured wall seconds per optimizer step over "
                          "the hook's window (loop-boundary cadence "
                          "pairs)",

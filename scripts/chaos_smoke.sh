@@ -64,8 +64,18 @@ assert not bad, f"overlap family legs failed: {bad}: {fams}"
 # back in is the overlap+accumN hangcheck schedule in the gate above
 assert accum["accum_steps"] == 4 and \
     accum["wire_bytes_per_step"] == accum["grad_bytes"], accum
+# hierarchical A/B leg (ISSUE 18): the staged exchange must trace on the
+# factored virtual mesh (2 "hosts" x 4 devices) and its inter-tier wire
+# must drop to ~1/4 of the flat leg (pad-tolerant 3x bound)
+hier = d["hierarchy"]
+assert "error" not in hier, f"hierarchy leg failed: {hier}"
+assert hier["intra_k"] == 4, hier
+assert hier["inter_wire_bytes"] * 3 < hier["flat_inter_wire_bytes"], hier
 print("overlap family sweep OK:",
       {k: v.get("on_vs_off") for k, v in fams.items()})
+print("hierarchy leg OK:",
+      {k: hier[k] for k in ("intra_k", "inter_wire_bytes",
+                            "flat_inter_wire_bytes", "hier_vs_flat_steps")})
 print(json.dumps(fams))
 '
   fi

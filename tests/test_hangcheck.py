@@ -329,8 +329,9 @@ def test_schedule_plan_mismatch_is_a_finding(devices, monkeypatch):
     monkeypatch.setitem(config_mod.PRESETS, "tiny_conv", _tiny_conv_preset)
     real = overlap.declared_bucket_collectives
 
-    def drifted(specs, out_specs=None, reduce_axes=("data", "fsdp")):
-        return real(specs, out_specs, reduce_axes=reduce_axes) \
+    def drifted(specs, out_specs=None, reduce_axes=("data", "fsdp"),
+                **kw):
+        return real(specs, out_specs, reduce_axes=reduce_axes, **kw) \
             + ["all_to_all@data"]
 
     monkeypatch.setattr(overlap, "declared_bucket_collectives", drifted)
@@ -389,9 +390,18 @@ def test_committed_artifact_matches_entry_shape():
     assert doc["schema_version"] == 1
     sigs = doc["signatures"]
     assert any(k.endswith("/overlap") for k in sigs)
-    for entry in sigs.values():
+    assert any(k.endswith("/overlap+hier") for k in sigs)
+    for key, entry in sigs.items():
         for op in entry["ops"]:
-            assert set(op) == {"op", "axes", "operands", "bytes", "count"}
+            base = {"op", "axes", "operands", "bytes", "count"}
+            extra = set(op) - base
+            assert base <= set(op), (key, op)
+            # grouped (hierarchical-tier) collectives additionally carry
+            # the group tiling + tier tag; flat ops must NOT grow keys —
+            # that is the pre-existing-family byte-identity contract.
+            assert extra <= {"tier", "groups"}, (key, op)
+            if extra:
+                assert key.endswith("/overlap+hier"), (key, op)
 
 
 # ---------------------------------------------------------------------------

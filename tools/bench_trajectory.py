@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Join the per-round bench records (BENCH_r0*.json at the repo root)
+"""Join the per-round bench records (BENCH_r*.json at the repo root)
 into ONE machine-readable perf trajectory.
 
 Each round's freeform ``parsed`` blob is flattened to dotted numeric
