@@ -1319,6 +1319,180 @@ def bench_serving(budget_left):
     }
 
 
+def bench_serving_fleet(budget_left):
+    """The fleet front door row (serve/router.py + serve/fleet.py;
+    docs/serving.md fleet section): three legs against a real 3-replica
+    routed fleet — steady open-loop load, a SIGKILL'd replica mid-load
+    (hedged retries bound client errors while the watchdog replaces it),
+    and a checkpoint published mid-load that rides the canary to a
+    promote. Replicas are real ``main.py`` serve subprocesses, so the
+    row also prices replica warm-up (spawn -> READY) and recovery
+    (kill -> readmit) in wall seconds."""
+    import shutil
+    import signal
+    import subprocess
+
+    from distributed_resnet_tensorflow_tpu.resilience.manifest import \
+        committed_steps
+    from distributed_resnet_tensorflow_tpu.serve.fleet import FleetSupervisor
+    from distributed_resnet_tensorflow_tpu.serve.loadgen import (
+        run_open_loop, synthetic_requests)
+    from distributed_resnet_tensorflow_tpu.serve.router import Router
+    from distributed_resnet_tensorflow_tpu.serve.server import serve_image_spec
+    from distributed_resnet_tensorflow_tpu.serve.wire import TcpReplicaClient
+    from distributed_resnet_tensorflow_tpu.utils.config import (
+        ExperimentConfig, get_preset)
+
+    if budget_left() < 300:
+        return {"skipped": "over bench budget (the fleet legs need ~300s)"}
+    root = tempfile.mkdtemp(prefix="drt_bench_fleet.")
+    ckpt_dir = os.path.join(root, "ckpt")
+    cfg = get_preset("smoke")
+    # serve_smoke.sh's SHRINK scale: the row measures the ROUTING tier
+    # (dispatch, hedging, replace, canary), not model compute
+    cfg.model.resnet_size = 8
+    cfg.model.compute_dtype = "float32"
+    cfg.data.image_size = 8
+    cfg.train.batch_size = 16
+    cfg.data.eval_batch_size = 16
+    cfg.mesh.data = 1
+    cfg.log_root = root
+    cfg.checkpoint.directory = ckpt_dir
+    cfg.checkpoint.async_save = False
+    cfg.checkpoint.save_every_secs = 0
+    cfg.checkpoint.save_every_steps = 2
+    cfg.serve.variants = ("f32",)
+    cfg.serve.max_queue_delay_ms = 5.0
+    cfg.serve.poll_interval_secs = 0.5
+    cfg.route.replicas = 3
+    cfg.route.health_interval_secs = 0.5
+    cfg.route.row_interval_secs = 2.0
+    cfg.route.watch_interval_secs = 0.5
+    cfg.route.replica_grace_secs = 2.0
+    cfg.route.request_timeout_ms = 8000
+    cfg.route.attempt_timeout_ms = 2000
+    cfg.route.hedge_ms = 250
+    cfg.route.canary_window_secs = 6.0
+    cfg.route.canary_min_samples = 8
+    cfg.route.canary_confirm_secs = 30.0
+
+    # replica/train subprocesses must come up as plain single-device CPU
+    # jax whatever this process was launched with
+    saved_env = {k: os.environ.get(k) for k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)
+    fleet = router = None
+    out = {"replicas": cfg.route.replicas}
+    try:
+        # 1) four training steps -> committed checkpoints 2 and 4; stash
+        # 4 under a non-committed name so it can be atomically PUBLISHED
+        # mid-load for the canary leg (commit = bare-step rename, the
+        # manifest protocol's own primitive)
+        tcfg = ExperimentConfig.from_dict(cfg.to_dict())
+        tcfg.mode = "train"
+        tcfg.train.train_steps = 4
+        tpath = os.path.join(root, "train.json")
+        with open(tpath, "w") as f:
+            f.write(tcfg.to_json())
+        subprocess.run(
+            [sys.executable, "-m", "distributed_resnet_tensorflow_tpu.main",
+             "--config_json", tpath],
+            check=True, timeout=max(120.0, budget_left() - 180),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        steps = committed_steps(ckpt_dir)
+        assert steps and steps[-1] >= 4, f"training left {steps}"
+        hold = os.path.join(root, "ckpt_hold_4")
+        os.rename(os.path.join(ckpt_dir, "4"), hold)
+
+        t0 = time.monotonic()
+        fleet = FleetSupervisor(cfg).start()
+        out["warm_secs"] = round(time.monotonic() - t0, 1)
+        clients = {rid: TcpReplicaClient("127.0.0.1", port)
+                   for rid, port in fleet.ports.items()}
+        shape, dtype = serve_image_spec(cfg)
+        from distributed_resnet_tensorflow_tpu.serve.fleet import write_pin
+        router = Router(
+            cfg.route, clients, shape, dtype,
+            beats_dir=fleet.beats_dir,
+            committed_steps_fn=lambda: committed_steps(ckpt_dir),
+            pin_fn=lambda rid, step: write_pin(root, rid, step),
+            initial_step=fleet.pinned_step).start()
+        fleet.attach_router(router)
+        fleet.start_watch()
+
+        # leg 1: steady open-loop load across the healthy fleet
+        out["steady"] = run_open_loop(router, qps=30.0, duration_secs=6.0,
+                                      seed=0)
+        # leg 2: SIGKILL one replica mid-load — hedges absorb the loss,
+        # the watchdog replaces; client errors stay bounded
+        errors_before = router.report()["errors"]
+        os.kill(fleet.procs[0].pid, signal.SIGKILL)
+        kill = run_open_loop(router, qps=30.0, duration_secs=8.0, seed=1)
+        kill["errors_during"] = router.report()["errors"] - errors_before
+        t1 = time.monotonic()
+        deadline = t1 + min(90.0, max(20.0, budget_left() - 90))
+        while (router.health_state(0) not in ("ready", "degraded")
+               and time.monotonic() < deadline):
+            time.sleep(0.5)
+        kill["replaces"] = fleet.replaces
+        kill["recovered"] = router.health_state(0) in ("ready", "degraded")
+        kill["recover_secs"] = round(time.monotonic() - t1, 1)
+        out["kill"] = kill
+
+        # leg 3: publish the stashed checkpoint mid-trickle — the canary
+        # fraction serves it first; the verdict promotes it fleet-wide
+        if budget_left() > 60:
+            os.rename(hold, os.path.join(ckpt_dir, "4"))
+            pool = synthetic_requests(router.image_shape,
+                                      router.image_dtype, pool=4, seed=2)
+            t2 = time.monotonic()
+            deadline = t2 + min(
+                cfg.route.canary_window_secs
+                + cfg.route.canary_confirm_secs + 20.0,
+                max(20.0, budget_left() - 30))
+            i = 0
+            while (router.canary.fleet_step < 4
+                   and 4 not in router.canary.bad_steps
+                   and time.monotonic() < deadline):
+                # concurrent bursts, not one-at-a-time: sequential probes
+                # all tie-break onto the lowest rid and starve the control
+                # arm of the verdict samples
+                futs = []
+                for _ in range(4):
+                    futs.append(router.submit(pool[i % len(pool)]))
+                    i += 1
+                for fut in futs:
+                    try:
+                        fut.result(timeout=10.0)
+                    except Exception:  # noqa: BLE001 — probe losses ok
+                        pass
+                time.sleep(0.2)
+            out["canary"] = {
+                "published_step": 4,
+                "promoted": router.canary.fleet_step == 4,
+                "rolled_back": 4 in router.canary.bad_steps,
+                "verdict_secs": round(time.monotonic() - t2, 1),
+            }
+        else:
+            out["canary"] = {"skipped": "over bench budget"}
+        rep = router.report()
+        out["router"] = {k: rep[k] for k in
+                         ("requests", "completed", "errors", "shed",
+                          "degraded", "hedges", "retries", "fleet_step")}
+    finally:
+        if router is not None:
+            router.close()
+        if fleet is not None:
+            fleet.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def attention_grad_ms(attn_fn, q, k, v, iters=10, reps=3):
     """ms per fwd+bwd of ``attn_fn`` timed inside a lax.scan (the remote-
     tunnel dispatch floor would swamp per-call timing), fenced through a
@@ -1422,6 +1596,11 @@ def main():
                      else {"skipped": "over bench budget"}),
                     # the serving row (serve/): p50/p99 + QPS per bucket
                     ("serving", lambda: bench_serving(budget_left)),
+                    # the fleet front door row (serve/router.py): steady
+                    # load, a replica SIGKILL mid-load, a mid-load canary
+                    # publish -> promote
+                    ("serving_fleet",
+                     lambda: bench_serving_fleet(budget_left)),
                     # goodput/step-breakdown (telemetry/): where a real
                     # streamed training run's wall-clock went — the
                     # before/after number for ROADMAP items 2 and 5

@@ -128,8 +128,12 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
             from .plan_drift import run_plan_drift, write_plan_catalog
             t4 = time.perf_counter()
             sigs_for_plan = None
-            if not ns.no_hangcheck:
-                sigs_for_plan = sigs  # the freshly traced map
+            if not ns.no_hangcheck and presets is None:
+                # full sweeps cost the freshly traced map; a scoped run
+                # (--preset X) only traced X's schedules, so costing the
+                # planned presets against it would flag every other one
+                # as missing — fall back to the committed artifact
+                sigs_for_plan = sigs
             pfs, plan_doc = run_plan_drift(sigs_for_plan,
                                            n_devices=ns.devices)
             print(f"plan-drift: {len(pfs)} finding(s), "

@@ -62,6 +62,17 @@ THREAD_ROLES = {
     "resilience/heartbeat.py::HeartbeatPublisher._run": ROLE_DAEMON,
     "resilience/watchdog.py::Watchdog._run": ROLE_DAEMON,
     "serve/swap.py::CheckpointSwapper._run": ROLE_DAEMON,
+    # the fleet front door (docs/serving.md fleet section): the replica-
+    # side listener threads decode bytes and park on Futures (submitter
+    # role — the batcher's dispatch thread still owns every execution);
+    # the router/supervisor threads are numpy-and-sockets only by
+    # construction (serve/router.py holds no jax state at all)
+    "serve/wire.py::ReplicaListener._accept_loop": ROLE_DAEMON,
+    "serve/wire.py::ReplicaListener._handle_conn": ROLE_DAEMON,
+    "serve/router.py::Router._dispatch_loop": ROLE_DAEMON,
+    "serve/router.py::Router._worker_loop": ROLE_DAEMON,
+    "serve/router.py::Router._health_loop": ROLE_DAEMON,
+    "serve/fleet.py::FleetSupervisor._watch": ROLE_DAEMON,
     # the reshard teardown's bounded jax.distributed.shutdown: shutting
     # down the dead generation's coordination client can block on a lost
     # peer, so it runs on a joined-with-timeout daemon and is abandoned
@@ -73,14 +84,19 @@ THREAD_ROLES = {
 #: entry points that constitute the LOOP/DISPATCH side for the blocking-
 #: call rule: the train/eval loop plus the functions the serve dispatch
 #: thread runs (the batcher's dispatch_fn callback is dynamic, so the
-#: server's dispatch body is rooted explicitly).
+#: server's dispatch body is rooted explicitly), plus the fleet front
+#: door's request path — one untimed wait in the router or a connection
+#: handler would let a dead replica park the service forever.
 LOOP_ROOTS = (
     "train/loop.py::Trainer.train",
     "train/loop.py::Trainer.evaluate",
     "main.py::run_train",
     "main.py::run_eval",
     "main.py::run_train_and_eval",
-    "serve/server.py::InferenceServer._dispatch_batch",
+    "serve/server.py::InferenceServer._run_bucket",
+    "serve/router.py::Router._dispatch_loop",
+    "serve/router.py::Router._worker_loop",
+    "serve/wire.py::ReplicaListener._handle_conn",
 )
 
 

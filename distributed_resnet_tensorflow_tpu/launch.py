@@ -128,6 +128,33 @@ def _signal_all(procs: List[subprocess.Popen], sig: int,
             pass
 
 
+def terminate_child(proc: subprocess.Popen,
+                    grace_secs: float = TERM_TO_KILL_SECS,
+                    kill_after: float = TERM_TO_KILL_SECS) -> int:
+    """Escalation ladder for ONE child: SIGTERM → wait ``grace_secs`` →
+    SIGKILL → wait ``kill_after`` → reap. Returns the exit code (negative
+    = signal death). Shared by the serving fleet supervisor
+    (serve/fleet.py replica replace) so every child teardown in the tree
+    follows the same term-then-kill contract as the training launcher."""
+    if proc.poll() is None:
+        try:
+            proc.terminate()
+        except ProcessLookupError:
+            pass
+        try:
+            proc.wait(timeout=grace_secs)
+        except subprocess.TimeoutExpired:
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+            try:
+                proc.wait(timeout=kill_after)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+    return proc.returncode if proc.returncode is not None else -signal.SIGKILL
+
+
 def _aggregate_rc(codes: List[int], forced: set) -> int:
     """Exit-code policy (module docstring): real failure > resumable > 0.
     Signal deaths (negative codes) of children the supervisor did NOT kill

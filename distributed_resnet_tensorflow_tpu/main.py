@@ -827,20 +827,44 @@ def run_serve(cfg: ExperimentConfig):
     import time as _time
 
     from .serve.loadgen import run_open_loop, synthetic_requests
-    from .serve.server import InferenceServer
+    from .serve.server import InferenceServer, serve_stream_dir
 
-    serve_dir = os.path.join(cfg.log_root, "serve")
-    writer = _make_writer(cfg, "serve") if is_chief() else None
+    serve_dir = serve_stream_dir(cfg)
+    replica_id = cfg.serve.replica_id
+    writer = _make_writer(cfg, os.path.basename(serve_dir)) \
+        if is_chief() else None
     _configure_telemetry(cfg, writer, jax.process_index())
     server = InferenceServer(cfg, writer=writer)
+    publisher = None
+    listener = None
+    if replica_id >= 0:
+        # fleet replica: publish liveness beats under the replica id so
+        # the router/supervisor can tell dead (no beats) from wedged
+        # (beats flowing, requests failing) — docs/serving.md fleet
+        from .resilience.heartbeat import (FileBeatTransport,
+                                           HeartbeatPublisher)
+        publisher = HeartbeatPublisher(
+            FileBeatTransport(
+                os.path.join(cfg.log_root, "heartbeats-serve"), replica_id),
+            process_id=replica_id).start()
+        publisher.set_phase("serve")
+        server.heartbeat = publisher
     load = None
     try:
         server.start()
-        # orchestration marker (scripts/serve_smoke.sh waits on it before
-        # publishing checkpoints: a commit landing before the initial
-        # restore would be picked up at startup, not hot-swapped)
+        if cfg.serve.listen_port > 0:
+            from .serve.wire import ReplicaListener
+            listener = ReplicaListener(server,
+                                       cfg.serve.listen_port).start()
+        # orchestration marker (scripts/serve_smoke.sh and the fleet
+        # supervisor wait on it before publishing checkpoints / routing:
+        # a commit landing before the initial restore would be picked up
+        # at startup, not hot-swapped)
+        os.makedirs(serve_dir, exist_ok=True)
         with open(os.path.join(serve_dir, "READY"), "w") as f:
-            f.write(str(os.getpid()))
+            f.write(_json.dumps({
+                "pid": os.getpid(),
+                "port": listener.port if listener is not None else 0}))
         if cfg.serve.load_qps > 0:
             load = run_open_loop(server, cfg.serve.load_qps,
                                  cfg.serve.load_duration_secs,
@@ -883,10 +907,128 @@ def run_serve(cfg: ExperimentConfig):
                 for sig, handler in prev.items():
                     signal.signal(sig, handler)
     finally:
+        if listener is not None:
+            listener.close()  # stop intake before the drain
         server.close()  # drains: every accepted request is answered
+        if publisher is not None:
+            publisher.close()
         if writer is not None:
             writer.close()
     report = server.report()
+    if load is not None:
+        report["load"] = load
+    print(_json.dumps(report))
+    return report
+
+
+def run_route(cfg: ExperimentConfig):
+    """Fleet front door mode (serve/router.py + serve/fleet.py;
+    docs/serving.md fleet section): spawn ``route.replicas`` serving
+    replica processes, route open-loop load across them with
+    least-outstanding dispatch + hedged retries, watchdog-replace dead or
+    wedged replicas, canary new checkpoints with auto-rollback, and shed
+    or degrade under queue pressure.
+
+    With ``route.load_qps > 0`` the open-loop generator
+    (``route.load_shape`` arrival schedule) drives the fleet, an
+    in-flight canary is drained to a verdict on trickle traffic, then a
+    JSON report prints and the process exits — scripts/serve_fleet_smoke.sh
+    and bench's serving_fleet row. With ``load_qps = 0`` the router runs
+    until SIGTERM/SIGINT (requests would come from in-process submit)."""
+    import json as _json
+    import time as _time
+
+    from .resilience.manifest import committed_steps
+    from .serve.fleet import FleetSupervisor, write_pin
+    from .serve.loadgen import run_open_loop, synthetic_requests
+    from .serve.router import Router
+    from .serve.server import serve_image_spec
+    from .serve.wire import TcpReplicaClient
+
+    route_dir = os.path.join(cfg.log_root, "route")
+    writer = _make_writer(cfg, "route")
+    _configure_telemetry(cfg, writer, 0)
+    ckpt_dir = resolve_checkpoint_dir(cfg)
+    fleet = FleetSupervisor(cfg, writer=writer)
+    router = None
+    load = None
+    try:
+        fleet.start()
+        clients = {rid: TcpReplicaClient("127.0.0.1", port)
+                   for rid, port in fleet.ports.items()}
+        shape, dtype = serve_image_spec(cfg)
+        router = Router(
+            cfg.route, clients, shape, dtype, writer=writer,
+            beats_dir=fleet.beats_dir,
+            committed_steps_fn=lambda: committed_steps(ckpt_dir),
+            pin_fn=lambda rid, step: write_pin(cfg.log_root, rid, step),
+            initial_step=fleet.pinned_step).start()
+        fleet.attach_router(router)
+        fleet.start_watch()
+        os.makedirs(route_dir, exist_ok=True)
+        with open(os.path.join(route_dir, "READY"), "w") as f:
+            f.write(_json.dumps({"pid": os.getpid()}))
+        if cfg.route.load_qps > 0:
+            load = run_open_loop(router, cfg.route.load_qps,
+                                 cfg.route.load_duration_secs,
+                                 seed=cfg.route.load_seed,
+                                 shape=cfg.route.load_shape)
+            # a checkpoint committed near the end of the load may not
+            # have started its canary yet — give the health loop a few
+            # turns to notice it before deciding whether to drain one
+            grace = _time.monotonic() + 3 * cfg.route.health_interval_secs
+            while (_time.monotonic() < grace
+                   and router.canary.active is None):
+                steps = committed_steps(ckpt_dir)
+                newest = max(steps) if steps else -1
+                if (newest <= router.canary.fleet_step
+                        or newest in router.canary.bad_steps):
+                    break
+                _time.sleep(0.2)
+            # drain an in-flight canary to a verdict: without traffic the
+            # arms never accumulate samples and every canary would decay
+            # to no_confirm/starved — trickle probes keep both arms fed
+            pool = synthetic_requests(router.image_shape,
+                                      router.image_dtype, pool=4,
+                                      seed=cfg.route.load_seed + 1)
+            deadline = _time.monotonic() + cfg.route.canary_window_secs \
+                + cfg.route.canary_confirm_secs + 15.0
+            i = 0
+            while (router.canary.active is not None
+                   and _time.monotonic() < deadline):
+                fut = router.submit(pool[i % len(pool)])
+                i += 1
+                try:
+                    fut.result(timeout=10.0)
+                except Exception:  # noqa: BLE001 — probe losses are fine
+                    pass
+                _time.sleep(0.05)
+        else:
+            import signal
+            import threading
+            stop = threading.Event()
+            prev = {}
+            if threading.current_thread() is threading.main_thread():
+                for sig in (signal.SIGTERM, signal.SIGINT):
+                    prev[sig] = signal.signal(
+                        sig, lambda *_args: stop.set())
+            log.info("routing (no load generator); SIGTERM/Ctrl-C stops "
+                     "with a full drain")
+            try:
+                while not stop.wait(1.0):
+                    pass
+            except KeyboardInterrupt:
+                pass
+            finally:
+                for sig, handler in prev.items():
+                    signal.signal(sig, handler)
+    finally:
+        if router is not None:
+            router.close()  # before fleet.stop(): no requests race kills
+        fleet.stop()
+        writer.close()
+    report = {"router": router.report() if router is not None else {},
+              "fleet": fleet.report()}
     if load is not None:
         report["load"] = load
     print(_json.dumps(report))
@@ -1095,6 +1237,12 @@ def main(argv=None):
         # is sugar for `--set mode=serve`
         serve_cmd = True
         argv = argv[1:]
+    route_cmd = False
+    if argv and argv[0] == "route":
+        # serving-fleet front door (serve/router.py + serve/fleet.py,
+        # docs/serving.md fleet section) — sugar for `--set mode=route`
+        route_cmd = True
+        argv = argv[1:]
     # honor JAX_PLATFORMS even when a site plugin (e.g. this environment's
     # axon sitecustomize) overrode it via jax.config at interpreter start
     if os.environ.get("JAX_PLATFORMS"):
@@ -1102,6 +1250,8 @@ def main(argv=None):
     cfg = parse_args(argv)
     if serve_cmd:
         cfg.mode = "serve"
+    if route_cmd:
+        cfg.mode = "route"
     if cfg.analysis.dispatch_sanitizer:
         # opt-in cross-thread dispatch guard (analysis/dispatch_sanitizer):
         # a second dispatching thread raises at its call site instead of
@@ -1129,6 +1279,8 @@ def main(argv=None):
             run_train_and_eval(cfg)
         elif cfg.mode == "serve":
             run_serve(cfg)
+        elif cfg.mode == "route":
+            run_route(cfg)
         else:
             raise ValueError(f"unknown mode {cfg.mode!r}")
     except Preempted as p:

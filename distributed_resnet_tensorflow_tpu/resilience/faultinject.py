@@ -292,6 +292,89 @@ def _scoped_env_value(environ, env_var: str, process_id: Optional[int],
         return None
 
 
+# -- serving-replica faults (fleet chaos: serve/server.py dispatch path) ----
+
+SERVE_WEDGE_ENV_VAR = "DRT_FAULT_SERVE_WEDGE_AT_BATCH"
+SERVE_SLOW_ENV_VAR = "DRT_FAULT_SERVE_SLOW_MS"
+
+
+def _parse_slow_ms(value: str):
+    """``"MS"`` or ``"MS@STEP"`` → (delay_ms, from_step). STEP is a
+    CHECKPOINT step (0 = always), not a batch index: the late-onset form
+    exists to poison whichever replicas serve a given published step."""
+    if "@" in value:
+        ms, _, step = value.partition("@")
+        return float(ms), int(step)
+    return float(value), 0
+
+
+class ServeFaults:
+    """Env-armed faults on a serving replica's DISPATCH thread, fired at
+    the top of every batch (serve/server.py ``_run_bucket``) — the fleet
+    chaos menu scripts/serve_fleet_smoke.sh and the slow tier drive:
+
+      * ``DRT_FAULT_SERVE_WEDGE_AT_BATCH=[rid:]N`` — block (bounded)
+        before dispatching the N-th batch: the wedged-dispatch shape.
+        The process stays alive and its heartbeat daemon keeps beating,
+        so only request failures/timeouts can condemn it — exactly the
+        alive-but-useless case the router health machine + fleet
+        supervisor exist for.
+      * ``DRT_FAULT_SERVE_SLOW_MS=[rid:]MS[@STEP]`` — MS extra per batch
+        once ``serving_step >= STEP``. Set fleet-wide with ``@STEP`` at
+        an upcoming checkpoint step, ONLY the replicas pinned to that
+        step slow down — a p99-regressing canary checkpoint without
+        touching the checkpoint bytes.
+
+    The ``rid:`` prefix scopes to one replica id (``serve.replica_id``),
+    mirroring the training faults' ``pid:`` scoping."""
+
+    def __init__(self, wedge_at_batch: Optional[int] = None,
+                 slow_ms: float = 0.0, slow_from_step: int = 0,
+                 freeze_secs: float = 3600.0):
+        self.wedge_at_batch = wedge_at_batch
+        self.slow_ms = slow_ms
+        self.slow_from_step = slow_from_step
+        self.freeze_secs = freeze_secs
+        self._wedged = False
+
+    @classmethod
+    def from_env(cls, replica_id: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None) -> "ServeFaults":
+        environ = os.environ if env is None else env
+        rid = replica_id if replica_id is not None and replica_id >= 0 \
+            else None
+        wedge = _scoped_env_value(environ, SERVE_WEDGE_ENV_VAR, rid, int)
+        if wedge is not None and wedge < 1:
+            wedge = None
+        slow = _scoped_env_value(environ, SERVE_SLOW_ENV_VAR, rid,
+                                 _parse_slow_ms)
+        slow_ms, slow_from = slow if slow is not None else (0.0, 0)
+        out = cls(wedge_at_batch=wedge, slow_ms=max(0.0, slow_ms),
+                  slow_from_step=slow_from)
+        if out.armed:
+            log.warning("fault injection armed (serve replica %s): "
+                        "wedge_at_batch=%s slow_ms=%s from_step=%s",
+                        rid, out.wedge_at_batch, out.slow_ms,
+                        out.slow_from_step)
+        return out
+
+    @property
+    def armed(self) -> bool:
+        return self.wedge_at_batch is not None or self.slow_ms > 0
+
+    def maybe_fire(self, batch_index: int, serving_step: int) -> None:
+        """Called per dispatch batch (1-based index) with the step the
+        batch will serve. Wedge fires at most once per process."""
+        if (self.wedge_at_batch is not None and not self._wedged
+                and batch_index >= self.wedge_at_batch):
+            self._wedged = True
+            log.warning("fault injection: serve dispatch wedging at batch "
+                        "%d for up to %.0fs", batch_index, self.freeze_secs)
+            time.sleep(self.freeze_secs)
+        if self.slow_ms > 0 and serving_step >= self.slow_from_step:
+            time.sleep(self.slow_ms / 1000.0)
+
+
 _nan_armed = False
 _freeze_armed = False
 

@@ -1,14 +1,26 @@
-"""serve/ — AOT-compiled batched inference with hot checkpoint swap.
+"""serve/ — AOT-compiled batched inference with hot checkpoint swap,
+and the fleet front door above it.
 
 The serving path the ROADMAP north-star requires and the reference never
 had (its pipeline ended at the checkpoint): ``main.py serve`` turns a
-training run's committed checkpoints into live low-latency capacity.
-docs/serving.md is the manual; tests/test_serve.py and
-scripts/serve_smoke.sh exercise it on CPU.
+training run's committed checkpoints into live low-latency capacity, and
+``main.py route`` (serve/router.py + serve/fleet.py) turns N such
+replicas into a service — health-routed dispatch, hedged retries,
+watchdog replace, canary rollout with auto-rollback, SLO-aware
+shedding. docs/serving.md is the manual; tests/test_serve.py,
+tests/test_router.py and scripts/serve{,_fleet}_smoke.sh exercise it on
+CPU.
+
+Import layering: ``router``/``wire``/``fleet``/``loadgen`` are
+numpy-and-sockets only (no jax) so the front door and its tier-1 tables
+never pay — or depend on — a device runtime; they are therefore NOT
+re-exported here (this package ``__init__`` pulls in the jax-backed
+server).
 """
 from .batcher import DynamicBatcher  # noqa: F401
 from .compile_cache import (ServeCompileCache, bucket_sizes,  # noqa: F401
                             pick_bucket)
 from .loadgen import run_open_loop, synthetic_requests  # noqa: F401
-from .server import InferenceServer, serve_image_spec  # noqa: F401
+from .server import (InferenceServer, serve_image_spec,  # noqa: F401
+                     serve_stream_dir)
 from .swap import CheckpointSwapper, PendingSwap  # noqa: F401

@@ -1,0 +1,315 @@
+"""Fleet supervisor: spawn N serving replicas, watchdog-replace the dead.
+
+The training side's supervisor (launch.py) answers "a child exited — now
+what?"; this one also has to answer "a child is ALIVE but useless" — a
+wedged dispatch thread keeps the process (and its heartbeat publisher)
+running while every request times out. The replace ladder mirrors the
+training watchdog escalation (docs/resilience.md):
+
+    condemn (router health says dead, or the process exited)
+      → drain   (router stops routing to it; in-flight attempts hedge
+                 to survivors)
+      → kill    (launch.terminate_child: SIGTERM → grace → SIGKILL)
+      → respawn (same replica id, same port, same config file)
+      → warm    (wait for the replica's READY marker, bounded)
+      → readmit (router resets the client pool and probes it back to
+                 ready)
+
+Every rung lands a ``replica_replace`` row; a crash-looping fleet is
+bounded by ``route.max_replaces`` (the ``gave_up`` row is the operator's
+page). Replicas are ordinary ``main.py`` processes fed a JSON config
+(``--config_json``) with ``serve.replica_id`` / ``serve.listen_port`` /
+``serve.swap_gate`` set — there is no special replica binary to drift.
+
+Checkpoint pinning: before the first replica spawns, the supervisor
+writes every replica's SWAP_CONTROL.json at the newest committed step
+(when one exists). From then on replicas only follow the router's pins —
+a checkpoint committed mid-rollout reaches the canary fraction first and
+the rest of the fleet only after the canary verdict (serve/router.py
+CanaryController).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..launch import terminate_child
+from ..utils.config import ExperimentConfig, resolve_checkpoint_dir
+from ..resilience.manifest import committed_steps
+
+log = logging.getLogger(__name__)
+
+
+def replica_dir(log_root: str, rid: int) -> str:
+    """Per-replica artifact dir: metrics stream, READY marker, swap pin."""
+    return os.path.join(log_root, f"serve-r{rid}")
+
+
+def pin_path(log_root: str, rid: int) -> str:
+    return os.path.join(replica_dir(log_root, rid), "SWAP_CONTROL.json")
+
+
+def write_pin(log_root: str, rid: int, step: int) -> None:
+    """Atomically pin one replica's serving step (the swapper follows it
+    forward for a rollout, backward for a rollback)."""
+    path = pin_path(log_root, rid)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"target_step": int(step)}, f)
+    os.replace(tmp, path)
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FleetSupervisor:
+    """Owns the replica processes of one routed serving fleet."""
+
+    def __init__(self, cfg: ExperimentConfig, writer=None,
+                 clock=time.monotonic):
+        self.cfg = cfg
+        self.rcfg = cfg.route
+        self.writer = writer
+        self.clock = clock
+        self.router = None  # attached after construction (it needs ports)
+        self.route_dir = os.path.join(cfg.log_root, "route")
+        self.beats_dir = os.path.join(cfg.log_root, "heartbeats-serve")
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.ports: Dict[int, int] = {}
+        self.rcs: Dict[int, int] = {}
+        self.replaces = 0
+        self.pinned_step = -1  # the step every replica was pinned at spawn
+        self._gave_up: set = set()
+        self._stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._logs: List[object] = []
+
+    # -- spawn / warm ------------------------------------------------------
+
+    def start(self, wait_ready: bool = True) -> "FleetSupervisor":
+        os.makedirs(self.route_dir, exist_ok=True)
+        n = max(1, self.rcfg.replicas)
+        for rid in range(n):
+            self.ports[rid] = (self.rcfg.base_port + rid
+                               if self.rcfg.base_port > 0 else _free_port())
+        step = self.initial_step()
+        self.pinned_step = step
+        if step >= 0:
+            # pin BEFORE the first spawn: a checkpoint committed while
+            # the fleet warms must reach the canary fraction first, never
+            # a baseline replica chasing the newest commit ungated
+            for rid in range(n):
+                write_pin(self.cfg.log_root, rid, step)
+        for rid in range(n):
+            self.procs[rid] = self._spawn(rid)
+        if wait_ready:
+            deadline = self.clock() + self.rcfg.warm_timeout_secs
+            for rid in range(n):
+                if self._wait_ready(rid, deadline) is None:
+                    raise RuntimeError(
+                        f"replica {rid} not READY within "
+                        f"{self.rcfg.warm_timeout_secs:.0f}s — see "
+                        f"{self._log_path(rid)}")
+        return self
+
+    def initial_step(self) -> int:
+        """Newest committed checkpoint step, or -1 (fresh-init serving)."""
+        try:
+            steps = committed_steps(resolve_checkpoint_dir(self.cfg))
+        except OSError:
+            steps = []
+        return max(steps) if steps else -1
+
+    def _config_path(self, rid: int) -> str:
+        return os.path.join(self.route_dir, f"replica{rid}.json")
+
+    def _log_path(self, rid: int) -> str:
+        return os.path.join(self.route_dir, f"replica{rid}.log")
+
+    def _replica_cfg(self, rid: int) -> str:
+        """Materialize replica ``rid``'s config file: the fleet's own
+        config with mode=serve, fleet identity set, self-driven load off
+        (the router is the only load source) and swaps gated on the pin."""
+        rep = ExperimentConfig.from_dict(self.cfg.to_dict())
+        rep.mode = "serve"
+        rep.serve.replica_id = rid
+        rep.serve.listen_port = self.ports[rid]
+        rep.serve.swap_gate = True
+        rep.serve.load_qps = 0.0
+        rep.serve.wait_for_swap_secs = 0.0
+        path = self._config_path(rid)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(rep.to_json())
+        os.replace(tmp, path)
+        return path
+
+    def _spawn(self, rid: int) -> subprocess.Popen:
+        cfg_path = self._replica_cfg(rid)
+        ready = os.path.join(replica_dir(self.cfg.log_root, rid), "READY")
+        try:
+            os.remove(ready)  # a stale marker must not fake a warm replica
+        except OSError:
+            pass
+        cmd = [sys.executable, "-m",
+               "distributed_resnet_tensorflow_tpu.main",
+               "--config_json", cfg_path]
+        out = open(self._log_path(rid), "a")
+        self._logs.append(out)
+        proc = subprocess.Popen(cmd, env=dict(os.environ), stdout=out,
+                                stderr=out)
+        log.info("fleet: replica %d spawned pid %d port %d", rid, proc.pid,
+                 self.ports[rid])
+        return proc
+
+    def _wait_ready(self, rid: int, deadline: float) -> Optional[dict]:
+        """Poll for the replica's READY marker; None on timeout, early
+        exit, or supervisor stop."""
+        ready = os.path.join(replica_dir(self.cfg.log_root, rid), "READY")
+        while self.clock() < deadline and not self._stop.is_set():
+            proc = self.procs.get(rid)
+            if proc is not None and proc.poll() is not None:
+                log.error("fleet: replica %d exited rc=%s while warming",
+                          rid, proc.returncode)
+                return None
+            try:
+                with open(ready) as f:
+                    raw = f.read().strip()
+            except OSError:
+                raw = ""
+            if raw:
+                try:
+                    return json.loads(raw)
+                except ValueError:
+                    return {"pid": int(raw)} if raw.isdigit() else {}
+            self._stop.wait(0.2)
+        return None
+
+    # -- watchdog ----------------------------------------------------------
+
+    def attach_router(self, router) -> None:
+        self.router = router
+
+    def start_watch(self) -> None:
+        self._watch_thread = threading.Thread(
+            target=self._watch, daemon=True, name="drt-fleet-watch")
+        self._watch_thread.start()
+
+    def _watch(self) -> None:
+        interval = max(0.1, self.rcfg.watch_interval_secs)
+        while not self._stop.is_set():
+            self._stop.wait(interval)
+            if self._stop.is_set():
+                return
+            try:
+                self._watch_pass()
+            except Exception:  # noqa: BLE001 — the watchdog must outlive
+                log.exception("fleet: watch pass failed")  # any one replace
+
+    def _watch_pass(self) -> None:
+        for rid, proc in list(self.procs.items()):
+            if rid in self._gave_up:
+                continue
+            rc = proc.poll()
+            if rc is not None:
+                self._replace(rid, "exited", rc=rc)
+            elif (self.router is not None
+                  and self.router.health_state(rid) == "dead"):
+                # alive-but-useless: fresh beats mean the process runs
+                # while requests fail (wedged dispatch); stale beats mean
+                # the whole process is gone dark
+                age = self._beat_age(rid)
+                wedged = (age is not None
+                          and age <= self.rcfg.beat_stale_secs)
+                self._replace(rid, "wedged" if wedged else "dead")
+
+    def _beat_age(self, rid: int) -> Optional[float]:
+        path = os.path.join(self.beats_dir, f"proc{rid}.json")
+        try:
+            with open(path) as f:
+                beat = json.load(f)
+            return max(0.0, time.time() - float(beat.get("wall_time", 0)))
+        except (OSError, ValueError):
+            return None
+
+    def _row(self, payload: dict) -> None:
+        if self.writer is not None:
+            self.writer.write_event("replica_replace", payload)
+
+    def _replace(self, rid: int, reason: str,
+                 rc: Optional[int] = None) -> None:
+        if self.replaces >= self.rcfg.max_replaces:
+            self._gave_up.add(rid)
+            log.error("fleet: replace budget exhausted (%d); replica %d "
+                      "stays down (%s)", self.replaces, rid, reason)
+            self._row({"replica": rid, "action": "gave_up",
+                       "reason": reason})
+            return
+        self.replaces += 1
+        proc = self.procs[rid]
+        old_pid = proc.pid
+        log.warning("fleet: replacing replica %d pid %d (%s, rc=%s) — "
+                    "replace %d/%d", rid, old_pid, reason, rc,
+                    self.replaces, self.rcfg.max_replaces)
+        if self.router is not None:
+            self.router.mark_draining(rid)
+        kill_row = {"replica": rid, "action": "kill", "reason": reason,
+                    "pid": old_pid}
+        if rc is not None:
+            kill_row["rc"] = rc
+        self._row(kill_row)
+        self.rcs[rid] = terminate_child(
+            proc, grace_secs=self.rcfg.replica_grace_secs)
+        t0 = self.clock()
+        self.procs[rid] = self._spawn(rid)
+        self._row({"replica": rid, "action": "respawn", "reason": reason,
+                   "new_pid": self.procs[rid].pid})
+        info = self._wait_ready(rid, t0 + self.rcfg.warm_timeout_secs)
+        if info is None:
+            self._gave_up.add(rid)
+            self._row({"replica": rid, "action": "gave_up",
+                       "reason": reason, "new_pid": self.procs[rid].pid})
+            return
+        if self.router is not None:
+            self.router.readmit(rid)
+        self._row({"replica": rid, "action": "readmit", "reason": reason,
+                   "new_pid": self.procs[rid].pid,
+                   "wait_secs": round(self.clock() - t0, 1)})
+
+    # -- teardown / reporting ---------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=10.0)
+            self._watch_thread = None
+        for rid, proc in self.procs.items():
+            self.rcs[rid] = terminate_child(
+                proc, grace_secs=self.rcfg.replica_grace_secs)
+        for out in self._logs:
+            try:
+                out.close()
+            except OSError:
+                pass
+        self._logs = []
+
+    def report(self) -> dict:
+        return {
+            "replicas": len(self.procs),
+            "ports": dict(self.ports),
+            "pids": {r: p.pid for r, p in self.procs.items()},
+            "replaces": self.replaces,
+            "gave_up": sorted(self._gave_up),
+            "exit_codes": dict(self.rcs),
+        }

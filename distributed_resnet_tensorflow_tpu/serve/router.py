@@ -1,0 +1,880 @@
+"""Fleet front door: health-routed dispatch, hedged retries, canary
+rollout with auto-rollback, SLO-aware shedding.
+
+Topology (``main.py route``)::
+
+    loadgen ──▶ Router.submit ──▶ intake ──▶ drt-route-dispatch
+                    │ (admission:                  │ least-outstanding
+                    │  shed / degrade)             ▼
+                    │                        attempt queue
+                    │                     ┌────────┴────────┐
+                    ▼                     ▼                 ▼
+               Future (per       drt-route-worker ×K  (TcpReplicaClient
+               request, first        per-attempt timeout; failure →
+               winning attempt       health signal + bounded retry)
+               resolves it)
+                          drt-route-health: heartbeat ages, ping probes,
+                          pressure, canary turn, route/shed rows
+
+Three cooperating state machines, all **pure and clock-injected** so the
+tier-1 tables drive them with a fake clock and zero sockets:
+
+* :class:`ReplicaHealth` — per-replica ``warming → ready ⇄ degraded``,
+  with ``suspect → dead`` on consecutive transport failures, ``dead`` on
+  a stale heartbeat, ``draining``/``readmit`` under supervisor control.
+  Only ``ready``/``degraded`` replicas take dispatch; when none qualify
+  the router falls back to anything not dead/draining rather than
+  refusing every request during a rough patch.
+* :class:`CanaryController` — a newly committed checkpoint step is first
+  pinned to ``ceil(canary_fraction × N)`` replicas (the rest re-pinned
+  to the incumbent step). After the watch window, the canary arm must
+  beat a p99 ratio and an accuracy-proxy (mean top-1 softmax) drop
+  threshold against the control arm, else every canary is re-pinned to
+  the old step and the step is remembered as bad — the serving analog of
+  the verified-restore ladder (docs/resilience.md).
+* Admission — estimated queue delay ``outstanding × EWMA service time /
+  eligible replicas``; past ``degrade_queue_ms`` unpinned traffic is
+  rewritten to the cheap variant (int8/bf16), past ``shed_queue_ms`` the
+  request is refused with :class:`RequestShed` instead of queueing
+  without bound.
+
+The router holds NO jax state — numpy in, numpy out — so a wedged
+replica can never wedge the front door, and the routing tables run in
+tier-1 without devices.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..telemetry.tracer import span
+from ..utils.config import RouteConfig
+from .wire import ReplicaError
+
+log = logging.getLogger(__name__)
+
+# health states (string-valued: they land in replica_health rows as-is)
+WARMING = "warming"
+READY = "ready"
+DEGRADED = "degraded"
+SUSPECT = "suspect"
+DRAINING = "draining"
+DEAD = "dead"
+
+#: states that take regular dispatch
+DISPATCHABLE = (READY, DEGRADED)
+#: states excluded even from the nothing-else-left fallback
+UNROUTABLE = (DEAD, DRAINING)
+
+
+class RequestShed(RuntimeError):
+    """Admission refused the request: estimated queue delay exceeded
+    route.shed_queue_ms. Clients should back off, not retry hot."""
+
+
+class RouteError(RuntimeError):
+    """Every attempt failed or the request deadline passed."""
+
+
+@dataclass
+class Transition:
+    """One health-state edge — becomes a replica_health row verbatim."""
+    replica: int
+    frm: str
+    to: str
+    reason: str
+    beat_age_secs: Optional[float] = None
+    failures: int = 0
+
+    def row(self) -> dict:
+        out = {"replica": self.replica, "from": self.frm, "to": self.to,
+               "reason": self.reason, "failures": self.failures}
+        if self.beat_age_secs is not None:
+            out["beat_age_secs"] = round(self.beat_age_secs, 1)
+        return out
+
+
+class ReplicaHealth:
+    """Health state machine for ONE replica. Pure: inputs are success /
+    failure / beat-age / pressure observations, outputs are
+    :class:`Transition` records (None when the state didn't move)."""
+
+    def __init__(self, replica: int, suspect_after: int = 2,
+                 dead_after: int = 5, beat_stale_secs: float = 15.0,
+                 slo_p99_ms: float = 0.0):
+        self.replica = replica
+        self.suspect_after = max(1, suspect_after)
+        self.dead_after = max(self.suspect_after, dead_after)
+        self.beat_stale_secs = beat_stale_secs
+        self.slo_p99_ms = slo_p99_ms
+        self.state = WARMING
+        self.failures = 0
+        self.beat_age: Optional[float] = None
+
+    def _move(self, to: str, reason: str) -> Optional[Transition]:
+        if to == self.state:
+            return None
+        tr = Transition(self.replica, self.state, to, reason,
+                        self.beat_age, self.failures)
+        self.state = to
+        return tr
+
+    def on_success(self) -> Optional[Transition]:
+        """A transport attempt (request or ping) came back."""
+        was = self.state
+        self.failures = 0
+        if was == WARMING:
+            return self._move(READY, "probe_ok")
+        if was == SUSPECT:
+            return self._move(READY, "recovered")
+        return None
+
+    def on_failure(self) -> Optional[Transition]:
+        """A transport attempt failed (ReplicaError)."""
+        if self.state in (DEAD, DRAINING):
+            return None
+        self.failures += 1
+        if self.failures >= self.dead_after:
+            return self._move(DEAD, "failures")
+        if self.failures >= self.suspect_after and self.state != SUSPECT:
+            return self._move(SUSPECT, "failures")
+        return None
+
+    def on_beat(self, age_secs: Optional[float]) -> Optional[Transition]:
+        """Heartbeat-file age (None = no beat published yet). A warming
+        replica is exempt — the supervisor bounds warm-up separately."""
+        self.beat_age = age_secs
+        if (age_secs is not None and age_secs > self.beat_stale_secs
+                and self.state not in (DEAD, DRAINING, WARMING)):
+            return self._move(DEAD, "beat_stale")
+        return None
+
+    def on_pressure(self, p99_ms: Optional[float]) -> Optional[Transition]:
+        """Router-observed p99 for this replica vs. the SLO."""
+        if self.slo_p99_ms <= 0 or p99_ms is None:
+            return None
+        if self.state == READY and p99_ms > self.slo_p99_ms:
+            return self._move(DEGRADED, "slo_pressure")
+        if self.state == DEGRADED and p99_ms < 0.8 * self.slo_p99_ms:
+            return self._move(READY, "recovered")
+        return None
+
+    def drain(self) -> Optional[Transition]:
+        return self._move(DRAINING, "drain")
+
+    def readmit(self) -> Optional[Transition]:
+        """Supervisor respawned the process: back to warming; the next
+        successful probe promotes it to ready."""
+        self.failures = 0
+        self.beat_age = None
+        return self._move(WARMING, "readmit")
+
+    @property
+    def dispatchable(self) -> bool:
+        return self.state in DISPATCHABLE
+
+
+def pick_replica(health: Dict[int, ReplicaHealth],
+                 outstanding: Dict[int, int],
+                 exclude: Sequence[int] = ()) -> Optional[int]:
+    """Least-outstanding-requests choice among dispatchable replicas,
+    falling back to anything routable; ``exclude`` (replicas this request
+    already tried) is a preference, not a veto — a retry with every
+    replica tried still goes somewhere."""
+    pool = [r for r, h in health.items() if h.dispatchable]
+    if not pool:
+        pool = [r for r, h in health.items() if h.state not in UNROUTABLE]
+    if not pool:
+        return None
+    fresh = [r for r in pool if r not in exclude]
+    return min(fresh or pool, key=lambda r: (outstanding.get(r, 0), r))
+
+
+def percentile_ms(samples: Sequence[float], q: float = 99.0) -> Optional[float]:
+    if not samples:
+        return None
+    data = sorted(samples)
+    idx = max(0, math.ceil(q / 100.0 * len(data)) - 1)
+    return data[idx]
+
+
+def top1_confidence(logits_row: np.ndarray) -> float:
+    """Accuracy proxy: top-1 softmax confidence of one logits row. A
+    garbage checkpoint (wrong params, NaN-poisoned, stale stats) shows up
+    as a confidence collapse long before labeled accuracy is measurable
+    router-side."""
+    row = np.asarray(logits_row, dtype=np.float64).reshape(-1)
+    if row.size == 0 or not np.all(np.isfinite(row)):
+        return 0.0
+    row = row - row.max()
+    ex = np.exp(row)
+    return float(ex.max() / ex.sum())
+
+
+# ---------------------------------------------------------------------------
+# canary rollout
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Canary:
+    step: int
+    from_step: int
+    canary: Tuple[int, ...]
+    all_ids: Tuple[int, ...]
+    started: float
+    confirmed: Set[int] = field(default_factory=set)
+    c_lat: deque = field(default_factory=lambda: deque(maxlen=2048))
+    c_conf: deque = field(default_factory=lambda: deque(maxlen=2048))
+    b_lat: deque = field(default_factory=lambda: deque(maxlen=2048))
+    b_conf: deque = field(default_factory=lambda: deque(maxlen=2048))
+
+
+class CanaryController:
+    """Decides which replica serves which checkpoint step. Pure: commits,
+    completions and clock ticks in; (canary rows, pin actions) out. The
+    caller executes pins by rewriting each replica's SWAP_CONTROL.json.
+
+    Not thread-safe by itself — the Router serializes calls under its own
+    lock (completions arrive from worker threads, ticks from the health
+    thread)."""
+
+    def __init__(self, cfg: RouteConfig, initial_step: int = -1):
+        self.cfg = cfg
+        self.fleet_step = initial_step  # step the non-canary fleet serves
+        self.bad_steps: Set[int] = set()
+        self.active: Optional[_Canary] = None
+
+    def observe_commit(self, step: Optional[int], healthy: Sequence[int],
+                       all_ids: Sequence[int],
+                       now: float) -> Tuple[List[dict], List[Tuple[int, int]]]:
+        """A newly committed checkpoint step appeared (or None). Starts a
+        canary when it is newer than the fleet step and not known-bad."""
+        if (step is None or self.active is not None
+                or step <= self.fleet_step or step in self.bad_steps
+                or not all_ids):
+            return [], []
+        ids = tuple(sorted(all_ids))
+        if len(ids) <= 1:
+            # nothing to compare against — promote directly, recorded as
+            # such so the operator knows no canary protected this swap
+            old = self.fleet_step
+            self.fleet_step = step
+            row = {"action": "promote", "step": step, "from_step": old,
+                   "canary": list(ids), "rollback": False,
+                   "reason": "single_replica"}
+            return [row], [(r, step) for r in ids]
+        k = max(1, math.ceil(self.cfg.canary_fraction * len(ids)))
+        k = min(k, len(ids) - 1)  # always keep a control arm
+        pool = [r for r in sorted(healthy) if r in ids] or list(ids)
+        canary = tuple(sorted(pool[:k]))
+        self.active = _Canary(step=step, from_step=self.fleet_step,
+                              canary=canary, all_ids=ids, started=now)
+        row = {"action": "start", "step": step,
+               "from_step": self.fleet_step, "canary": list(canary),
+               "rollback": False}
+        pins = [(r, step if r in canary else self.fleet_step) for r in ids]
+        return [row], pins
+
+    @property
+    def unconfirmed(self) -> List[int]:
+        """Canary replicas that have not yet been seen serving the canary
+        step — the health pass pings these even when healthy (a canary
+        starved of regular traffic must not read as no_confirm)."""
+        c = self.active
+        if c is None:
+            return []
+        return sorted(set(c.canary) - c.confirmed)
+
+    def observe_step(self, replica: int, step: int) -> None:
+        """A replica was SEEN serving ``step`` (health-ping pong). Counts
+        as swap confirmation only — latency/confidence samples for the
+        verdict still come exclusively from real completions."""
+        c = self.active
+        if c is not None and replica in c.canary and step == c.step:
+            c.confirmed.add(replica)
+
+    def observe_completion(self, replica: int, step: int, latency_ms: float,
+                           conf: float) -> None:
+        c = self.active
+        if c is None:
+            return
+        if replica in c.canary:
+            if step == c.step:
+                c.confirmed.add(replica)
+                c.c_lat.append(latency_ms)
+                c.c_conf.append(conf)
+        elif step != c.step:  # control arm; a canary-step answer from a
+            c.b_lat.append(latency_ms)  # non-canary replica would be the
+            c.b_conf.append(conf)       # leak the smoke asserts against
+
+    def tick(self, now: float) -> Tuple[List[dict], List[Tuple[int, int]]]:
+        c = self.active
+        if c is None:
+            return [], []
+        cfg = self.cfg
+        elapsed = now - c.started
+        confirmed = set(c.canary) <= c.confirmed
+        if not confirmed:
+            if elapsed >= cfg.canary_confirm_secs:
+                return self._rollback("no_confirm")
+            return [], []
+        enough = (len(c.c_lat) >= cfg.canary_min_samples
+                  and len(c.b_lat) >= cfg.canary_min_samples)
+        if elapsed < cfg.canary_window_secs:
+            return [], []
+        if not enough:
+            if elapsed >= cfg.canary_window_secs + cfg.canary_confirm_secs:
+                # starved of traffic: every canary confirmed the step and
+                # nothing regressed in what little we saw — promote
+                return self._promote("promoted")
+            return [], []
+        p99c = percentile_ms(c.c_lat)
+        p99b = percentile_ms(c.b_lat)
+        if p99b and p99c and p99c > cfg.canary_p99_ratio * p99b:
+            return self._rollback("p99_regression")
+        conf_c = sum(c.c_conf) / len(c.c_conf) if c.c_conf else 0.0
+        conf_b = sum(c.b_conf) / len(c.b_conf) if c.b_conf else 0.0
+        if conf_b - conf_c > cfg.canary_conf_drop:
+            return self._rollback("confidence_regression")
+        return self._promote("promoted")
+
+    def _stats(self, c: _Canary) -> dict:
+        out = {"samples_canary": len(c.c_lat), "samples_base": len(c.b_lat)}
+        p99c, p99b = percentile_ms(c.c_lat), percentile_ms(c.b_lat)
+        if p99c is not None:
+            out["p99_canary_ms"] = round(p99c, 2)
+        if p99b is not None:
+            out["p99_base_ms"] = round(p99b, 2)
+        if c.c_conf:
+            out["conf_canary"] = round(sum(c.c_conf) / len(c.c_conf), 4)
+        if c.b_conf:
+            out["conf_base"] = round(sum(c.b_conf) / len(c.b_conf), 4)
+        return out
+
+    def _rollback(self, reason: str) -> Tuple[List[dict],
+                                              List[Tuple[int, int]]]:
+        c = self.active
+        self.active = None
+        self.bad_steps.add(c.step)
+        row = {"action": "rollback", "step": c.step,
+               "from_step": c.from_step, "canary": list(c.canary),
+               "rollback": True, "reason": reason, **self._stats(c)}
+        log.warning("canary: ROLLBACK step %d → %d (%s)", c.step,
+                    c.from_step, reason)
+        return [row], [(r, c.from_step) for r in c.canary]
+
+    def _promote(self, reason: str) -> Tuple[List[dict],
+                                             List[Tuple[int, int]]]:
+        c = self.active
+        self.active = None
+        self.fleet_step = c.step
+        row = {"action": "promote", "step": c.step,
+               "from_step": c.from_step, "canary": list(c.canary),
+               "rollback": False, "reason": reason, **self._stats(c)}
+        log.info("canary: promote step %d fleet-wide (%s)", c.step, reason)
+        return [row], [(r, c.step) for r in c.all_ids]
+
+
+# ---------------------------------------------------------------------------
+# the router proper
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Request:
+    id: int
+    image: np.ndarray
+    variant: Optional[str]
+    future: Future
+    created: float
+    deadline: float
+    attempts: int = 0
+    inflight: int = 0
+    done: bool = False
+    hedged: bool = False
+    last_issue: float = 0.0
+    tried: Set[int] = field(default_factory=set)
+
+
+class Router:
+    """Admission + dispatch over a set of replica clients.
+
+    ``clients`` maps replica id → an object with ``request(image,
+    variant, timeout_secs) → (logits_row, step)``, ``ping(timeout_secs)
+    → dict`` and ``reset()`` — :class:`serve.wire.TcpReplicaClient` in
+    production, in-memory fakes in the tier-1 tables. ``submit`` mirrors
+    ``InferenceServer.submit`` (image → Future of (logits_row, step)) so
+    ``serve.loadgen`` drives a fleet exactly like a single replica."""
+
+    def __init__(self, cfg: RouteConfig, clients: Dict[int, object],
+                 image_shape: Tuple[int, ...], image_dtype,
+                 writer=None, beats_dir: Optional[str] = None,
+                 committed_steps_fn: Optional[Callable[[], List[int]]] = None,
+                 pin_fn: Optional[Callable[[int, int], None]] = None,
+                 initial_step: int = -1,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall_clock: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.clients = dict(clients)
+        self.image_shape = tuple(image_shape)
+        self.image_dtype = np.dtype(image_dtype)
+        self.writer = writer
+        self.beats_dir = beats_dir
+        self.committed_steps_fn = committed_steps_fn
+        self.pin_fn = pin_fn
+        self.clock = clock
+        self.wall_clock = wall_clock
+        self.canary = CanaryController(cfg, initial_step=initial_step)
+
+        self._lock = threading.Lock()
+        self.health: Dict[int, ReplicaHealth] = {
+            rid: ReplicaHealth(rid, cfg.suspect_after_failures,
+                               cfg.dead_after_failures,
+                               cfg.beat_stale_secs, cfg.slo_p99_ms)
+            for rid in self.clients}
+        self.outstanding: Dict[int, int] = {r: 0 for r in self.clients}
+        self.served: Dict[int, int] = {r: 0 for r in self.clients}
+        self.last_step: Dict[int, int] = {r: -1 for r in self.clients}
+        self._lat_by_replica: Dict[int, deque] = {
+            r: deque(maxlen=512) for r in self.clients}  # (t, ms)
+        self._window: deque = deque(maxlen=4096)  # (t, ms) firsts only
+        self._ewma_ms = 50.0
+
+        self.requests = 0
+        self.completed = 0
+        self.errors = 0
+        self.shed = 0
+        self.degraded = 0
+        self.hedges = 0
+        self.retries = 0
+
+        self._intake: "queue.Queue[_Request]" = queue.Queue()
+        self._attempts: "queue.Queue[Tuple[_Request, int, int]]" = \
+            queue.Queue()
+        self._pending: Dict[int, _Request] = {}
+        self._next_id = 0
+        self._last_shed_row = -1e9
+        self._last_route_row = 0.0
+        self._row_marks: deque = deque(maxlen=8)  # (t, completed) per row
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Router":
+        self._last_route_row = self.clock()
+        self._row_marks.append((self._last_route_row, 0))
+        spawned = [threading.Thread(target=self._dispatch_loop, daemon=True,
+                                    name="drt-route-dispatch"),
+                   threading.Thread(target=self._health_loop, daemon=True,
+                                    name="drt-route-health")]
+        spawned += [threading.Thread(target=self._worker_loop, daemon=True,
+                                     name="drt-route-worker")
+                    for _ in range(max(1, self.cfg.workers))]
+        for t in spawned:
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        now = self.clock()
+        with self._lock:
+            stuck = [r for r in self._pending.values() if not r.done]
+            for req in stuck:
+                req.done = True
+                self.errors += 1
+            self._pending.clear()
+        for req in stuck:
+            req.future.set_exception(RouteError("router closed"))
+        self._write_route_row(now, final=True)
+        for client in self.clients.values():
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, image, variant: Optional[str] = None) -> Future:
+        fut: Future = Future()
+        now = self.clock()
+        with self._lock:
+            eligible = sum(1 for h in self.health.values()
+                           if h.dispatchable) or 1
+            backlog = sum(self.outstanding.values()) + self._intake.qsize()
+            est_ms = backlog * self._ewma_ms / eligible
+            if (self.cfg.shed_queue_ms > 0
+                    and est_ms >= self.cfg.shed_queue_ms):
+                self.shed += 1
+                self._maybe_shed_row(now, est_ms, self.cfg.shed_queue_ms)
+                fut.set_exception(RequestShed(
+                    f"estimated queue delay {est_ms:.0f}ms >= "
+                    f"{self.cfg.shed_queue_ms:.0f}ms"))
+                return fut
+            if (self.cfg.degrade_queue_ms > 0 and variant is None
+                    and self.cfg.degrade_variant
+                    and est_ms >= self.cfg.degrade_queue_ms):
+                variant = self.cfg.degrade_variant
+                self.degraded += 1
+                self._maybe_shed_row(now, est_ms, self.cfg.degrade_queue_ms)
+            self._next_id += 1
+            req = _Request(
+                id=self._next_id,
+                image=np.asarray(image, dtype=self.image_dtype),
+                variant=variant, future=fut, created=now,
+                deadline=now + self.cfg.request_timeout_ms / 1000.0)
+            self.requests += 1
+            self._pending[req.id] = req
+        self._intake.put(req)
+        return req.future
+
+    def _maybe_shed_row(self, now: float, est_ms: float,
+                        threshold_ms: float) -> None:
+        # caller holds _lock; rate-limited to one row/sec so a shed storm
+        # cannot swamp the metrics stream
+        if self.writer is None or now - self._last_shed_row < 1.0:
+            return
+        self._last_shed_row = now
+        self.writer.write_event("shed", {
+            "count": self.shed, "degraded": self.degraded,
+            "est_queue_ms": round(est_ms, 1),
+            "threshold_ms": threshold_ms})
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        tick = max(0.005, self.cfg.hedge_ms / 1000.0 / 4.0)
+        while not self._stop.is_set():
+            try:
+                req = self._intake.get(timeout=tick)
+            except queue.Empty:
+                req = None
+            now = self.clock()
+            if req is not None and not req.done:
+                self._issue(req, now)
+            self._scan_pending(now)
+
+    def _issue(self, req: _Request, now: float) -> None:
+        with self._lock:
+            rid = pick_replica(self.health, self.outstanding, req.tried)
+            if rid is None:
+                if req.inflight == 0 and not req.done:
+                    req.done = True
+                    self.errors += 1
+                    self._pending.pop(req.id, None)
+                    fail = req.future
+                else:
+                    fail = None
+            else:
+                req.attempts += 1
+                req.inflight += 1
+                req.tried.add(rid)
+                req.last_issue = now
+                self.outstanding[rid] = self.outstanding.get(rid, 0) + 1
+                fail = None
+        if rid is None:
+            if fail is not None:
+                fail.set_exception(RouteError("no routable replica"))
+            return
+        self._attempts.put((req, rid, req.attempts))
+
+    def _scan_pending(self, now: float) -> None:
+        timed_out: List[_Request] = []
+        hedge: List[_Request] = []
+        with self._lock:
+            for req in list(self._pending.values()):
+                if req.done:
+                    self._pending.pop(req.id, None)
+                elif now >= req.deadline:
+                    req.done = True
+                    self.errors += 1
+                    self._pending.pop(req.id, None)
+                    timed_out.append(req)
+                elif (not req.hedged and req.inflight >= 1
+                      and req.attempts < self.cfg.max_attempts
+                      and now - req.last_issue
+                      >= self.cfg.hedge_ms / 1000.0):
+                    req.hedged = True
+                    self.hedges += 1
+                    hedge.append(req)
+        for req in timed_out:
+            req.future.set_exception(RouteError(
+                f"deadline after {req.attempts} attempt(s)"))
+        for req in hedge:
+            self._issue(req, now)
+
+    # -- workers -----------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                req, rid, attempt = self._attempts.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._run_attempt(req, rid, attempt)
+
+    def _run_attempt(self, req: _Request, rid: int, attempt: int) -> None:
+        client = self.clients.get(rid)
+        err: Optional[Exception] = None
+        result = None
+        timeout = min(self.cfg.attempt_timeout_ms / 1000.0,
+                      max(0.05, req.deadline - self.clock()))
+        try:
+            with span("route.attempt", replica=rid, attempt=attempt):
+                if client is None:
+                    raise ReplicaError(f"no client for replica {rid}")
+                result = client.request(req.image, req.variant,
+                                        timeout_secs=timeout)
+        except ReplicaError as e:
+            err = e
+        except Exception as e:  # noqa: BLE001 — a client bug is a
+            err = ReplicaError(f"{type(e).__name__}: {e}")  # failed attempt
+        with self._lock:
+            self.outstanding[rid] = max(0, self.outstanding.get(rid, 1) - 1)
+            req.inflight -= 1
+        if err is None:
+            self._attempt_ok(req, rid, *result)
+        else:
+            self._attempt_failed(req, rid, err)
+
+    def _attempt_ok(self, req: _Request, rid: int, row: np.ndarray,
+                    step: int) -> None:
+        now = self.clock()
+        latency_ms = (now - req.created) * 1000.0
+        conf = top1_confidence(row)
+        with self._lock:
+            tr = self.health[rid].on_success()
+            self.served[rid] = self.served.get(rid, 0) + 1
+            self.last_step[rid] = step
+            self._lat_by_replica[rid].append((now, latency_ms))
+            self.canary.observe_completion(rid, step, latency_ms, conf)
+            first = not req.done
+            if first:
+                req.done = True
+                self.completed += 1
+                self._pending.pop(req.id, None)
+                self._window.append((now, latency_ms))
+                self._ewma_ms += 0.2 * (latency_ms - self._ewma_ms)
+        self._write_transition(tr)
+        if first:
+            req.future.set_result((row, step))
+
+    def _attempt_failed(self, req: _Request, rid: int,
+                        err: Exception) -> None:
+        now = self.clock()
+        with self._lock:
+            tr = self.health[rid].on_failure()
+            if req.done:
+                retry = final = False
+            else:
+                retry = (req.attempts < self.cfg.max_attempts
+                         and now < req.deadline)
+                final = not retry and req.inflight == 0
+                if retry:
+                    self.retries += 1
+                if final:
+                    req.done = True
+                    self.errors += 1
+                    self._pending.pop(req.id, None)
+        self._write_transition(tr)
+        if retry:
+            self._issue(req, now)
+        elif final:
+            req.future.set_exception(RouteError(
+                f"{req.attempts} attempt(s) failed; last: {err}"))
+
+    # -- health / canary ---------------------------------------------------
+
+    def _health_loop(self) -> None:
+        interval = max(0.05, self.cfg.health_interval_secs)
+        while not self._stop.is_set():
+            self._stop.wait(interval)
+            if self._stop.is_set():
+                return
+            try:
+                with span("route.health"):
+                    self._health_pass(self.clock())
+            except Exception:  # noqa: BLE001 — scan must never die
+                log.exception("route: health pass failed")
+
+    def _health_pass(self, now: float) -> None:
+        transitions: List[Transition] = []
+        ages = self._beat_ages()
+        with self._lock:
+            for rid, h in self.health.items():
+                transitions.append(h.on_beat(ages.get(rid)))
+                transitions.append(h.on_pressure(self._replica_p99(rid, now)))
+            probe = [r for r, h in self.health.items()
+                     if h.state in (WARMING, SUSPECT)]
+            # healthy canaries that have not confirmed the canary step
+            # yet are pinged too: without this, a canary the dispatch
+            # policy happens to starve of traffic (least-outstanding
+            # concentrates a trickle on one replica) would roll back a
+            # good step as no_confirm even though its swap landed
+            probe += [r for r in self.canary.unconfirmed
+                      if r not in probe and r in self.clients]
+        for rid in probe:
+            try:
+                pong = self.clients[rid].ping(timeout_secs=2.0)
+            except Exception:  # noqa: BLE001 — ReplicaError or a fake's
+                with self._lock:
+                    if self.health[rid].state == SUSPECT:
+                        transitions.append(self.health[rid].on_failure())
+            else:
+                with self._lock:
+                    transitions.append(self.health[rid].on_success())
+                    step = int(pong.get("step", -1))
+                    self.last_step[rid] = step
+                    self.canary.observe_step(rid, step)
+        for tr in transitions:
+            self._write_transition(tr)
+        self._canary_turn(now)
+        if now - self._last_route_row >= self.cfg.row_interval_secs:
+            self._write_route_row(now)
+
+    def _beat_ages(self) -> Dict[int, float]:
+        if not self.beats_dir:
+            return {}
+        out: Dict[int, float] = {}
+        wall = self.wall_clock()
+        for rid in self.clients:
+            path = os.path.join(self.beats_dir, f"proc{rid}.json")
+            try:
+                with open(path) as f:
+                    beat = json.load(f)
+                out[rid] = max(0.0, wall - float(beat.get("wall_time", 0)))
+            except (OSError, ValueError):
+                continue  # no beat yet / torn write: age unknown
+        return out
+
+    def _replica_p99(self, rid: int, now: float) -> Optional[float]:
+        # caller holds _lock
+        dq = self._lat_by_replica.get(rid)
+        if not dq:
+            return None
+        while dq and now - dq[0][0] > 30.0:
+            dq.popleft()
+        return percentile_ms([ms for _, ms in dq])
+
+    def _canary_turn(self, now: float) -> None:
+        newest = None
+        if self.committed_steps_fn is not None:
+            try:
+                steps = self.committed_steps_fn()
+                newest = max(steps) if steps else None
+            except OSError:
+                newest = None
+        rows: List[dict] = []
+        pins: List[Tuple[int, int]] = []
+        with self._lock:
+            healthy = [r for r, h in self.health.items() if h.dispatchable]
+            all_ids = [r for r, h in self.health.items()
+                       if h.state not in UNROUTABLE]
+            r1, p1 = self.canary.observe_commit(newest, healthy, all_ids,
+                                                now)
+            r2, p2 = self.canary.tick(now)
+            rows, pins = r1 + r2, p1 + p2
+        for rid, step in pins:
+            if self.pin_fn is not None:
+                try:
+                    self.pin_fn(rid, step)
+                except OSError:
+                    log.exception("route: pin replica %d → step %d failed",
+                                  rid, step)
+        if self.writer is not None:
+            for row in rows:
+                self.writer.write_event("canary", row)
+
+    # -- supervisor hooks --------------------------------------------------
+
+    def mark_draining(self, rid: int) -> None:
+        with self._lock:
+            tr = self.health[rid].drain()
+        self._write_transition(tr)
+
+    def readmit(self, rid: int) -> None:
+        client = self.clients.get(rid)
+        if client is not None:
+            client.reset()  # the old process's pooled sockets are corpses
+        with self._lock:
+            tr = self.health[rid].readmit()
+        self._write_transition(tr)
+
+    def health_state(self, rid: int) -> str:
+        with self._lock:
+            return self.health[rid].state
+
+    # -- reporting ---------------------------------------------------------
+
+    def _write_transition(self, tr: Optional[Transition]) -> None:
+        if tr is None:
+            return
+        log.info("route: replica %d %s → %s (%s)", tr.replica, tr.frm,
+                 tr.to, tr.reason)
+        if self.writer is not None:
+            self.writer.write_event("replica_health", tr.row())
+
+    def _replica_snapshot(self) -> Dict[str, dict]:
+        # caller holds _lock
+        now = self.clock()
+        out = {}
+        for rid, h in self.health.items():
+            snap = {"state": h.state, "step": self.last_step.get(rid, -1),
+                    "outstanding": self.outstanding.get(rid, 0),
+                    "served": self.served.get(rid, 0),
+                    "failures": h.failures}
+            p99 = self._replica_p99(rid, now)
+            if p99 is not None:
+                snap["p99_ms"] = round(p99, 2)
+            if h.beat_age is not None:
+                snap["beat_age_secs"] = round(h.beat_age, 1)
+            out[str(rid)] = snap
+        return out
+
+    def _write_route_row(self, now: float, final: bool = False) -> None:
+        with self._lock:
+            mark_t, mark_done = (self._row_marks[-1] if self._row_marks
+                                 else (now, self.completed))
+            dt = max(1e-6, now - mark_t)
+            qps = (self.completed - mark_done) / dt
+            while self._window and now - self._window[0][0] > dt:
+                self._window.popleft()
+            p99 = percentile_ms([ms for _, ms in self._window])
+            self._row_marks.append((now, self.completed))
+            self._last_route_row = now
+            payload = {"requests": self.requests,
+                       "completed": self.completed, "errors": self.errors,
+                       "shed": self.shed, "degraded": self.degraded,
+                       "hedges": self.hedges, "retries": self.retries,
+                       "qps": round(qps, 2),
+                       "replicas": self._replica_snapshot()}
+            if p99 is not None:
+                payload["p99_ms"] = round(p99, 2)
+        if self.writer is not None:
+            self.writer.write_event("route", payload)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "requests": self.requests, "completed": self.completed,
+                "errors": self.errors, "shed": self.shed,
+                "degraded": self.degraded, "hedges": self.hedges,
+                "retries": self.retries,
+                "fleet_step": self.canary.fleet_step,
+                "bad_steps": sorted(self.canary.bad_steps),
+                "replicas": self._replica_snapshot(),
+            }

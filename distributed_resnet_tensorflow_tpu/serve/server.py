@@ -20,6 +20,7 @@ construction; ``scripts/serve_smoke.sh`` runs with it armed.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -43,6 +44,16 @@ log = logging.getLogger(__name__)
 #: live_arrays scan never shows in serve tail latency, fine enough that a
 #: leak over a day-long run has hundreds of trend points
 _MEMORY_EVERY_BATCHES = 50
+
+
+def serve_stream_dir(cfg: ExperimentConfig) -> str:
+    """Where this serving process keeps its metrics stream / READY marker
+    / swap pin: ``<log_root>/serve`` standalone, ``<log_root>/serve-r<id>``
+    as a fleet replica (matches serve/fleet.replica_dir so supervisor and
+    replica agree without talking)."""
+    sub = "serve" if cfg.serve.replica_id < 0 \
+        else f"serve-r{cfg.serve.replica_id}"
+    return os.path.join(cfg.log_root, sub)
 
 
 def serve_image_spec(cfg: ExperimentConfig) -> Tuple[Tuple[int, ...], type]:
@@ -126,11 +137,16 @@ class InferenceServer:
         self.cache = ServeCompileCache(self.trainer,
                                        variant_predicts=variant_predicts)
         self.latency = LatencyStats()
+        # fleet mode: swaps follow the router's per-replica pin file
+        # (canary/rollback control) instead of chasing the newest commit
+        gate = os.path.join(serve_stream_dir(cfg), "SWAP_CONTROL.json") \
+            if cfg.serve.swap_gate else None
         self.swapper = CheckpointSwapper(
             resolve_checkpoint_dir(cfg),
             poll_secs=cfg.serve.poll_interval_secs,
             on_reject=self._on_swap_reject,
-            seed=cfg.serve.load_seed)
+            seed=cfg.serve.load_seed,
+            gate_path=gate)
         self.batcher = DynamicBatcher(
             self.buckets, self._run_bucket, self.image_shape,
             self.image_dtype,
@@ -142,6 +158,14 @@ class InferenceServer:
         self._t_start = time.monotonic()
         self._closed = False
         self._batches_since_mem = 0  # serve-side memory-row cadence
+        # fleet chaos knobs (DRT_FAULT_SERVE_*, scoped by replica id) —
+        # inert unless armed; fired at the top of every dispatch batch
+        from ..resilience.faultinject import ServeFaults
+        self._faults = ServeFaults.from_env(cfg.serve.replica_id)
+        # optional HeartbeatPublisher a fleet replica's run loop attaches
+        # (main.py run_serve); the dispatch thread updates step/progress
+        # so a wedged dispatch shows as frozen progress with live beats
+        self.heartbeat = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, start_threads: bool = True) -> "InferenceServer":
@@ -229,6 +253,9 @@ class InferenceServer:
         resolve futures. ``images`` is already padded to its bucket; the
         group is single-variant by the batcher's collection contract."""
         from ..parallel.sharding import finalize_staged
+        self._faults.maybe_fire(self.batcher.batches + 1, self.serving_step)
+        if self.heartbeat is not None:
+            self.heartbeat.update(step=max(0, self.serving_step))
         t0 = time.perf_counter()
         bucket = images.shape[0]
         variant = group[0].variant

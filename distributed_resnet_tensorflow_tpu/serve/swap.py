@@ -20,6 +20,7 @@ and keeps polling for the next good commit.
 """
 from __future__ import annotations
 
+import json
 import logging
 import os
 import random
@@ -73,12 +74,22 @@ class CheckpointSwapper:
 
     def __init__(self, directory: str, poll_secs: float = 5.0,
                  on_reject: Optional[Callable[[int, str], None]] = None,
-                 seed: int = 0):
+                 seed: int = 0, gate_path: Optional[str] = None):
         import orbax.checkpoint as ocp
         self.directory = directory
         self.poll_secs = max(0.1, poll_secs)
         self.last_seen: Optional[int] = None
         self.rejected = 0
+        # router-pinned serving (serve.swap_gate): with the gate armed
+        # the swapper ONLY follows the control file at gate_path
+        # ({"target_step": N}, written atomically by the fleet front
+        # door) — forward for a canary/promote, BACKWARD for a rollback,
+        # and HOLDS (keeps current params) while no pin exists. Chasing
+        # the newest commit before the router pins it would leak an
+        # unvalidated checkpoint to a baseline replica.
+        self.gate_path = gate_path
+        self._gate_applied: Optional[int] = None
+        self._gate_bad: set = set()
         self._on_reject = on_reject
         self._ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
         self._pending: Optional[PendingSwap] = None
@@ -96,7 +107,14 @@ class CheckpointSwapper:
         4 then 6 between polls, 6 tore → serve 4, not stale params
         forever). ``last_seen`` advances to the newest committed step
         regardless, so bad steps are skipped, never re-verified every
-        poll."""
+        poll.
+
+        Under a swap gate (``gate_path``) the walk is replaced by
+        pin-following: restore exactly the pinned step when it is
+        committed and not known-bad, whatever direction that moves the
+        replica; hold with no (or an unreadable) pin."""
+        if self.gate_path is not None:
+            return self._poll_gated(self._read_gate())
         steps = committed_steps(self.directory)
         if self.last_seen is not None:
             steps = [s for s in steps if s > self.last_seen]
@@ -110,6 +128,35 @@ class CheckpointSwapper:
             if pending is not None:
                 return pending
         return None
+
+    def _read_gate(self) -> Optional[int]:
+        """The pinned step, or None when no control file exists yet (a
+        replica spawned before any checkpoint was committed — hold)."""
+        try:
+            with open(self.gate_path) as f:
+                data = json.load(f)
+            return int(data["target_step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _poll_gated(self, target: Optional[int]) -> Optional[PendingSwap]:
+        if (target is None or target == self._gate_applied
+                or target in self._gate_bad):
+            return None
+        if target < 0 or target not in committed_steps(self.directory):
+            # pinned ahead of the directory (pin raced the commit) — keep
+            # polling; the step will appear or the pin will move
+            return None
+        step_dir = os.path.join(self.directory, str(target))
+        pending = self._load_step(target, step_dir,
+                                  manifest_digest(step_dir))
+        if pending is None:
+            # a damaged pinned step must not be re-verified every poll;
+            # the router sees no confirmation and rolls the canary back
+            self._gate_bad.add(target)
+            return None
+        self._gate_applied = target
+        return pending
 
     def restore_newest_valid(self) -> Optional[PendingSwap]:
         """STARTUP restore: the newest committed checkpoint that verifies,

@@ -1,0 +1,282 @@
+"""Request transport between the fleet router and its serving replicas.
+
+One frame = a 4-byte big-endian length, that many bytes of JSON header,
+then ``header["nbytes"]`` raw payload bytes (the image or logits array,
+C-contiguous). Requests carry {id, variant, shape, dtype, nbytes};
+responses {id, ok, step, shape, dtype, nbytes} or {id, ok: false, error}.
+A bodyless {"ping": true} frame answers {"pong": true, step, outstanding}
+— the router's readmission probe.
+
+Threading: the replica-side connection handlers are SUBMITTER threads in
+the docs/serving.md contract — they decode bytes, enqueue via
+``InferenceServer.submit`` and park on the Future with a timeout; the one
+dispatch thread still owns every multi-device execution. The router-side
+client keeps a small pool of persistent connections per replica, each
+checked out exclusively per request (no multiplexing — a worker thread
+owns one socket for the duration of one attempt). Every socket operation
+runs under a deadline-derived ``settimeout``: a dead peer is a loud
+``ReplicaError`` in seconds, never a parked thread.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+#: sanity bound on a frame header (a corrupt length prefix must not
+#: allocate gigabytes before failing)
+_MAX_HEADER = 1 << 20
+_MAX_BODY = 1 << 30
+
+
+class ReplicaError(RuntimeError):
+    """A transport attempt failed (connect/send/recv error or timeout) —
+    the router's cue to mark the replica suspect and hedge elsewhere."""
+
+
+def send_frame(sock: socket.socket, header: dict,
+               body: bytes = b"") -> None:
+    if body:
+        header = dict(header, nbytes=len(body))
+    raw = json.dumps(header).encode("utf-8")
+    sock.sendall(_LEN.pack(len(raw)) + raw + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ReplicaError("connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    n = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
+    if n > _MAX_HEADER:
+        raise ReplicaError(f"frame header of {n} bytes (corrupt stream?)")
+    header = json.loads(_recv_exact(sock, n).decode("utf-8"))
+    nbytes = int(header.get("nbytes", 0))
+    if not 0 <= nbytes <= _MAX_BODY:
+        raise ReplicaError(f"frame body of {nbytes} bytes (corrupt stream?)")
+    body = _recv_exact(sock, nbytes) if nbytes else b""
+    return header, body
+
+
+def _array_header(arr: np.ndarray) -> dict:
+    return {"shape": list(arr.shape), "dtype": arr.dtype.name}
+
+
+def _array_from(header: dict, body: bytes) -> np.ndarray:
+    arr = np.frombuffer(body, dtype=np.dtype(header["dtype"]))
+    return arr.reshape([int(d) for d in header["shape"]]).copy()
+
+
+# ---------------------------------------------------------------------------
+# router side: pooled client
+# ---------------------------------------------------------------------------
+
+class TcpReplicaClient:
+    """Persistent-connection client for one replica, checkout-per-request.
+
+    ``request`` raises :class:`ReplicaError` on ANY transport problem or
+    an error response — the caller (a router worker) translates that into
+    health signal + retry/hedge. A failed socket is discarded, never
+    returned to the pool."""
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout_secs: float = 5.0):
+        self.host = host
+        self.port = port
+        self.connect_timeout_secs = connect_timeout_secs
+        self._idle: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _checkout(self, timeout_secs: float) -> socket.socket:
+        with self._lock:
+            if self._closed:
+                raise ReplicaError("client closed")
+            if self._idle:
+                sock = self._idle.pop()
+                sock.settimeout(timeout_secs)
+                return sock
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port),
+                timeout=min(self.connect_timeout_secs, timeout_secs))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(timeout_secs)
+            return sock
+        except OSError as e:
+            raise ReplicaError(
+                f"connect to {self.host}:{self.port} failed: {e}") from e
+
+    def _checkin(self, sock: socket.socket) -> None:
+        with self._lock:
+            if not self._closed and len(self._idle) < 8:
+                self._idle.append(sock)
+                return
+        _close_quietly(sock)
+
+    def _roundtrip(self, header: dict, body: bytes,
+                   timeout_secs: float) -> Tuple[dict, bytes]:
+        sock = self._checkout(timeout_secs)
+        try:
+            send_frame(sock, header, body)
+            resp, payload = recv_frame(sock)
+        except ReplicaError:
+            _close_quietly(sock)
+            raise
+        except (OSError, ValueError) as e:
+            _close_quietly(sock)
+            raise ReplicaError(
+                f"{self.host}:{self.port}: {type(e).__name__}: {e}") from e
+        self._checkin(sock)
+        return resp, payload
+
+    def request(self, image: np.ndarray, variant: Optional[str],
+                timeout_secs: float) -> Tuple[np.ndarray, int]:
+        """One inference attempt → (logits_row, served_step)."""
+        image = np.ascontiguousarray(image)
+        header = {"variant": variant, **_array_header(image)}
+        resp, payload = self._roundtrip(header, image.tobytes(),
+                                        timeout_secs)
+        if not resp.get("ok"):
+            raise ReplicaError(
+                f"{self.host}:{self.port} rejected request: "
+                f"{resp.get('error', 'unknown')}")
+        return _array_from(resp, payload), int(resp.get("step", -1))
+
+    def ping(self, timeout_secs: float = 2.0) -> dict:
+        """Liveness/step probe (the readmission check)."""
+        resp, _ = self._roundtrip({"ping": True}, b"", timeout_secs)
+        if not resp.get("pong"):
+            raise ReplicaError(f"{self.host}:{self.port}: bad pong {resp}")
+        return resp
+
+    def reset(self) -> None:
+        """Drop pooled connections (a replaced replica's old sockets are
+        dead even though host:port is unchanged)."""
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for sock in idle:
+            _close_quietly(sock)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for sock in idle:
+            _close_quietly(sock)
+
+
+def _close_quietly(sock: socket.socket) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# replica side: listener
+# ---------------------------------------------------------------------------
+
+class ReplicaListener:
+    """TCP front of one serving replica: accept loop + per-connection
+    handler threads, all strictly submitter-role (enqueue + timed Future
+    wait; zero device work — the single-dispatch-thread contract holds by
+    construction)."""
+
+    def __init__(self, server, port: int, host: str = "127.0.0.1",
+                 result_timeout_secs: float = 60.0):
+        self.server = server
+        self.host = host
+        self.result_timeout_secs = result_timeout_secs
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def start(self) -> "ReplicaListener":
+        self._sock.listen(64)
+        self._sock.settimeout(0.5)  # accept wakes to observe _stop
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="drt-serve-accept")
+        self._accept_thread.start()
+        log.info("serve: replica listening on %s:%d", self.host, self.port)
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns = [c for c in self._conns if c.fileno() >= 0]
+                self._conns.append(conn)
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True, name="drt-serve-conn").start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        # per-op deadline: a half-sent frame from a dying router must not
+        # park this handler past the result timeout
+        conn.settimeout(self.result_timeout_secs)
+        try:
+            while not self._stop.is_set():
+                try:
+                    header, body = recv_frame(conn)
+                except (ReplicaError, socket.timeout, OSError, ValueError):
+                    return  # peer gone / idle past deadline: drop the conn
+                if header.get("ping"):
+                    send_frame(conn, {
+                        "pong": True, "step": self.server.serving_step,
+                        "pid": os.getpid(),
+                        "outstanding": self.server.dropped})
+                    continue
+                self._serve_one(conn, header, body)
+        finally:
+            _close_quietly(conn)
+
+    def _serve_one(self, conn: socket.socket, header: dict,
+                   body: bytes) -> None:
+        try:
+            image = _array_from(header, body)
+            fut = self.server.submit(image, variant=header.get("variant"))
+            row, step = fut.result(timeout=self.result_timeout_secs)
+        except Exception as e:  # noqa: BLE001 — answered, not crashed
+            send_frame(conn, {"ok": False,
+                              "error": f"{type(e).__name__}: {e}"[:300]})
+            return
+        row = np.ascontiguousarray(row)
+        send_frame(conn, {"ok": True, "step": int(step),
+                          **_array_header(row)}, row.tobytes())
+
+    def close(self) -> None:
+        self._stop.set()
+        _close_quietly(self._sock)
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            _close_quietly(conn)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+            self._accept_thread = None

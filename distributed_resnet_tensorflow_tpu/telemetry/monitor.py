@@ -150,6 +150,36 @@ def summarize_stream(stream_dir: str, now: Optional[float] = None) -> dict:
         out.setdefault("serve", {})["last_batch"] = {
             "bucket": sb.get("bucket"), "n": sb.get("n"),
             "run_ms": sb.get("run_ms")}
+    # fleet front door (serve/router.py): the route stream's periodic
+    # rollup row plus the newest canary / shed / replace events — enough
+    # to render the fleet line without re-deriving router state
+    rt = _last(rows, "route")
+    if rt is not None:
+        out["route"] = {
+            "requests": rt.get("requests"),
+            "completed": rt.get("completed"),
+            "errors": rt.get("errors"), "shed": rt.get("shed"),
+            "degraded": rt.get("degraded"), "hedges": rt.get("hedges"),
+            "retries": rt.get("retries"), "qps": rt.get("qps"),
+            "p99_ms": rt.get("p99_ms"),
+            "replicas": rt.get("replicas"),
+            "age_secs": round(now - rt.get("time", now), 1)}
+    cn = _last(rows, "canary")
+    if cn is not None:
+        out["canary"] = {
+            "action": cn.get("action"), "step": cn.get("step"),
+            "from_step": cn.get("from_step"), "canary": cn.get("canary"),
+            "rollback": cn.get("rollback"), "reason": cn.get("reason")}
+    sh = _last(rows, "shed")
+    if sh is not None:
+        out["shed"] = {"count": sh.get("count"),
+                       "degraded": sh.get("degraded"),
+                       "est_queue_ms": sh.get("est_queue_ms")}
+    rr = _last(rows, "replica_replace")
+    if rr is not None:
+        out["replica_replace"] = {
+            "replica": rr.get("replica"), "action": rr.get("action"),
+            "reason": rr.get("reason")}
     dump = _last(rows, "trace_dump")
     if dump is not None:
         out["trace_dump"] = {"reason": dump.get("reason"),
@@ -355,6 +385,22 @@ def aggregate(root: str, now: Optional[float] = None,
         if warn:
             out["hbm_warn_frac"] = hbm_warn_frac
             out["hbm_warn_hosts"] = warn
+    # fleet front door rollup: the route stream carries the router's own
+    # periodic row (per-replica health snapshot included), and the same
+    # stream's newest canary/shed/replace events ride along — the
+    # operator's one-glance answer to "is the fleet healthy, is a
+    # rollout in flight, are we shedding"
+    fleets = {name: s["route"] for name, s in streams.items()
+              if "route" in s}
+    if fleets:
+        lead_fleet = max(fleets,
+                         key=lambda n: fleets[n].get("requests") or 0)
+        fleet = dict(fleets[lead_fleet])
+        fleet["stream"] = lead_fleet
+        for key in ("canary", "shed", "replica_replace"):
+            if key in streams[lead_fleet]:
+                fleet[key] = streams[lead_fleet][key]
+        out["fleet"] = fleet
     # headline: the fastest train-shaped stream is the chief's
     rates = {name: s["steps_per_sec"] for name, s in streams.items()
              if "steps_per_sec" in s}
@@ -407,6 +453,36 @@ def render(agg: dict) -> str:
     if "last_committed_step" in agg:
         lines.append(f"  checkpoint: step {agg['last_committed_step']} "
                      "committed")
+    if "fleet" in agg:
+        fl = agg["fleet"]
+        reps = fl.get("replicas") or {}
+        states = " ".join(
+            f"r{rid}:{(cell or {}).get('state', '?')}"
+            f"@{(cell or {}).get('step', '?')}"
+            for rid, cell in sorted(reps.items()))
+        bits = ["  fleet:"]
+        if fl.get("qps") is not None:
+            bits.append(f"qps {fl['qps']:.1f}")
+        if fl.get("p99_ms") is not None:
+            bits.append(f"p99 {fl['p99_ms']:.0f}ms")
+        bits.append(f"errors {fl.get('errors', 0)}")
+        bits.append(f"shed {fl.get('shed', 0)}")
+        bits.append(f"degraded {fl.get('degraded', 0)}")
+        bits.append(f"hedges {fl.get('hedges', 0)}")
+        lines.append(" ".join(bits) + f" | {states}")
+        cn = fl.get("canary")
+        if cn:
+            verdict = ("ROLLED BACK" if cn.get("rollback")
+                       else cn.get("action"))
+            lines.append(
+                f"  canary: {verdict} step {cn.get('step')} "
+                f"(from {cn.get('from_step')}) on {cn.get('canary')} "
+                f"reason {cn.get('reason', '-')}")
+        rr = fl.get("replica_replace")
+        if rr:
+            lines.append(
+                f"  replace: replica {rr.get('replica')} "
+                f"{rr.get('action')} ({rr.get('reason')})")
     if "ckpt_shard_bytes_total" in agg:
         per_host = agg.get("ckpt_shard_bytes_by_host", {})
         mb = agg["ckpt_shard_bytes_total"] / 1e6

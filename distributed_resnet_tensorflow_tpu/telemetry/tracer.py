@@ -49,7 +49,9 @@ log = logging.getLogger(__name__)
 
 #: bump when the trace.json event shape changes (consumers key on it via
 #: the ``trace_dump`` metrics row and the file's otherData block)
-SPAN_SCHEMA_VERSION = 8  # 8: + plan.predict/plan.drift_check (what-if
+SPAN_SCHEMA_VERSION = 9  # 9: + route.attempt/route.health (serving
+#                              fleet front door, round 19)
+#                          8: + plan.predict/plan.drift_check (what-if
 #                              performance planner, round 17)
 #                          7: + reshard.* family (elastic mesh
 #                              shrink/grow transition, round 16)
@@ -131,6 +133,14 @@ SPAN_CATALOG = {
     "serve.variant_build": "one serving precision variant's weight copy "
                            "cast from the f32 masters (startup and every "
                            "hot swap; docs/precision.md)",
+    # serving fleet front door (serve/router.py, docs/serving.md)
+    "route.attempt": "one request attempt forwarded to a replica "
+                     "(router worker thread: send → response/failure; "
+                     "replica/attempt args — hedges and retries are "
+                     "extra route.attempt spans for the same request)",
+    "route.health": "one health-scan pass over the fleet (router health "
+                    "thread: heartbeat ages + telemetry tails + canary "
+                    "controller turn)",
     # elastic mesh generation transition (resilience/elastic.py;
     # goodput: reshard for every leg — the whole transition is
     # non-compute wall time)

@@ -656,6 +656,100 @@ class ServeConfig:
     # rebuild every variant from the new f32 masters. Checkpoints are
     # untouched (serving quantizes/casts at swap time, never at rest).
     variants: Tuple[str, ...] = ("f32",)
+    # -- fleet-replica identity (serve/fleet.py spawns replicas with
+    # these set; standalone `main.py serve` leaves them off) -------------
+    # replica id within a routed fleet: >= 0 moves the metrics stream /
+    # READY marker to <log_root>/serve-r<id> and publishes heartbeats
+    # into <log_root>/heartbeats-serve under this process_id
+    replica_id: int = -1
+    # TCP request port (127.0.0.1): > 0 starts the replica listener
+    # (serve/wire.py ReplicaListener) so a router can forward requests
+    listen_port: int = 0
+    # gate hot swaps on the router's per-replica control file
+    # (<serve dir>/SWAP_CONTROL.json {"target_step": N}): the swapper
+    # only moves to the pinned step — forward for a canary/promote,
+    # BACKWARD for a rollback — instead of chasing the newest commit,
+    # and HOLDS while no control file exists (an unpinned gated replica
+    # must not leak an unvalidated checkpoint past the canary).
+    swap_gate: bool = False
+
+
+@dataclass
+class RouteConfig:
+    """Serving-fleet front door (serve/router.py + serve/fleet.py;
+    ``main.py route``, docs/serving.md fleet section): health-routed
+    replicas, watchdog-driven replace, canary rollout with auto-rollback,
+    SLO-aware degradation."""
+
+    # -- fleet shape -----------------------------------------------------
+    replicas: int = 3
+    # first replica's TCP port; replica i listens on base_port + i.
+    # 0 = pick free ports at spawn time
+    base_port: int = 0
+    # forwarding worker threads (each blocks on one attempt at a time,
+    # so this bounds the router's concurrent in-flight attempts)
+    workers: int = 4
+    # -- request path ----------------------------------------------------
+    # client-visible deadline: past it the request fails loudly
+    request_timeout_ms: float = 10000.0
+    # per-attempt transport deadline (connect + send + response)
+    attempt_timeout_ms: float = 4000.0
+    # hedge: a duplicate attempt goes to ANOTHER replica after this long
+    # without a response — requests in flight on a dying replica land on
+    # a survivor instead of waiting out attempt_timeout_ms
+    hedge_ms: float = 400.0
+    # total attempts per request (first + hedges + retries)
+    max_attempts: int = 3
+    # -- health ----------------------------------------------------------
+    health_interval_secs: float = 1.0
+    # heartbeat age past which a replica is declared dead (its publisher
+    # daemon beats ~1/s even when the dispatch thread is stuck)
+    beat_stale_secs: float = 15.0
+    # consecutive transport failures: suspect (deprioritized), then dead
+    # (drained + replaced by the fleet supervisor)
+    suspect_after_failures: int = 2
+    dead_after_failures: int = 5
+    # route summary-row cadence ({"event": "route"})
+    row_interval_secs: float = 5.0
+    # -- canary rollout --------------------------------------------------
+    # fraction of the fleet a new checkpoint is published to first
+    # (ceil(fraction × replicas), never the whole fleet when N > 1)
+    canary_fraction: float = 0.34
+    # measurement window after every canary replica confirms the step
+    canary_window_secs: float = 15.0
+    # minimum responses per arm before a promote/rollback verdict
+    canary_min_samples: int = 20
+    # rollback when canary p99 / control p99 exceeds this
+    canary_p99_ratio: float = 2.0
+    # rollback when the canary arm's mean top-1 softmax confidence (the
+    # accuracy proxy) drops below the control arm's by more than this
+    canary_conf_drop: float = 0.2
+    # rollback when the canary replicas never confirm the step
+    canary_confirm_secs: float = 60.0
+    # -- SLO-aware degradation / load shedding ---------------------------
+    # p99 above this marks a replica degraded (slo_pressure); 0 = off
+    slo_p99_ms: float = 0.0
+    # estimated queue delay above this reroutes default-variant traffic
+    # to degrade_variant (0 = off); above shed_queue_ms requests are
+    # refused with the shed verdict instead of queueing unbounded
+    degrade_queue_ms: float = 0.0
+    degrade_variant: str = ""
+    shed_queue_ms: float = 2000.0
+    # -- fleet supervisor (watchdog replace) -----------------------------
+    watch_interval_secs: float = 1.0
+    # drain + SIGTERM grace before SIGKILL on a replace
+    replica_grace_secs: float = 10.0
+    # respawn → READY deadline before the replace is abandoned
+    warm_timeout_secs: float = 240.0
+    # total replaces before the supervisor stops trying (crash-loop cap)
+    max_replaces: int = 8
+    # -- open-loop load generator (mirrors serve.load_*) -----------------
+    load_qps: float = 0.0
+    load_duration_secs: float = 10.0
+    load_seed: int = 0
+    # arrival-schedule shape: steady | diurnal | burst | spike
+    # (serve/loadgen.py — all coordinated-omission-free)
+    load_shape: str = "steady"
 
 
 @dataclass
@@ -671,8 +765,9 @@ class ExperimentConfig:
     eval: EvalConfig = field(default_factory=EvalConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    route: RouteConfig = field(default_factory=RouteConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
-    mode: str = "train"               # train | eval | train_and_eval | serve
+    mode: str = "train"        # train | eval | train_and_eval | serve | route
     log_root: str = "/tmp/drt_tpu"    # reference log_root flag
 
     # ---- serialization ----

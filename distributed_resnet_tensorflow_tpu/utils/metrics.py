@@ -676,6 +676,96 @@ EVENT_SCHEMAS = {
                                  "rows only; applied rows carry to_step)",
         },
     },
+    "route": {
+        "emitted_by": "serve/router.py Router (route.row_interval_secs "
+                      "cadence + shutdown; the fleet front door's "
+                      "headline row — docs/serving.md fleet section)",
+        "fields": {
+            "requests": "requests admitted since router start",
+            "completed": "requests answered (first winning attempt)",
+            "errors": "client-visible failures (every attempt exhausted "
+                      "or deadline passed) — the smoke bounds these",
+            "shed": "requests refused with the shed verdict (cumulative)",
+            "degraded": "requests rerouted to the degrade variant under "
+                        "queue pressure (cumulative)",
+            "hedges": "extra attempts issued after hedge_ms without a "
+                      "response (cumulative)",
+            "retries": "extra attempts issued after a FAILED attempt "
+                       "(cumulative; hedges and retries are both bounded "
+                       "by route.max_attempts)",
+            "qps": "completions/sec over the row's window",
+            "p99_ms": "router-observed p99 request latency (submit → "
+                      "first winning response) over the row's window",
+            "replicas": "per-replica {state, step, outstanding, served, "
+                        "failures, p99_ms, beat_age_secs} snapshot",
+        },
+    },
+    "replica_health": {
+        "emitted_by": "serve/router.py Router (health-state transitions "
+                      "only, not per scan)",
+        "fields": {
+            "replica": "replica id (serve.replica_id of the process)",
+            "from": "previous health state (warming | ready | degraded | "
+                    "suspect | draining | dead)",
+            "to": "new health state",
+            "reason": "what moved it (probe_ok | failures | beat_stale | "
+                      "slo_pressure | recovered | drain | readmit)",
+            "beat_age_secs": "heartbeat age at the transition (absent "
+                             "when the replica never published a beat)",
+            "failures": "consecutive transport failures at the "
+                        "transition",
+        },
+    },
+    "canary": {
+        "emitted_by": "serve/router.py CanaryController (one row per "
+                      "lifecycle action: start, promote, rollback)",
+        "fields": {
+            "action": "start | promote | rollback",
+            "step": "checkpoint step under canary",
+            "from_step": "fleet step the canary would replace (rollback "
+                         "re-pins it)",
+            "canary": "replica ids serving the canary fraction",
+            "rollback": "true on the rollback row — the auto-rollback "
+                        "witness scripts/serve_fleet_smoke.sh asserts",
+            "reason": "decision detail (p99_regression | "
+                      "confidence_regression | no_confirm | promoted | "
+                      "single_replica)",
+            "p99_canary_ms": "canary-arm p99 over the watch window",
+            "p99_base_ms": "control-arm p99 over the watch window",
+            "conf_canary": "canary-arm mean top-1 softmax confidence "
+                           "(the accuracy proxy)",
+            "conf_base": "control-arm mean top-1 softmax confidence",
+            "samples_canary": "canary-arm responses measured",
+            "samples_base": "control-arm responses measured",
+        },
+    },
+    "shed": {
+        "emitted_by": "serve/router.py Router (rate-limited: at most one "
+                      "row per second while shedding/degrading)",
+        "fields": {
+            "count": "requests shed since router start (cumulative)",
+            "degraded": "requests rerouted to the degrade variant "
+                        "(cumulative)",
+            "est_queue_ms": "estimated queue delay that tripped the "
+                            "verdict (outstanding × EWMA service time / "
+                            "eligible replicas)",
+            "threshold_ms": "route.shed_queue_ms the estimate exceeded",
+        },
+    },
+    "replica_replace": {
+        "emitted_by": "serve/fleet.py FleetSupervisor (watchdog replace "
+                      "ladder: drain → kill → respawn → readmit)",
+        "fields": {
+            "replica": "replica id being replaced",
+            "action": "kill | respawn | readmit | gave_up",
+            "reason": "what condemned it (exited | wedged | dead)",
+            "pid": "pid of the condemned process (kill rows)",
+            "rc": "exit code observed (when the process had exited)",
+            "new_pid": "pid of the respawned process (respawn/readmit "
+                       "rows)",
+            "wait_secs": "respawn → READY wall time (readmit rows)",
+        },
+    },
     "reshard": {
         "emitted_by": "resilience/elastic.py ElasticRuntime (one row per "
                       "completed mesh-generation transition; docs/"

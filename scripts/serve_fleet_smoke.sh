@@ -1,0 +1,152 @@
+#!/bin/bash
+# Fleet front door smoke — the end-to-end proof of the routed serving
+# tier (serve/router.py + serve/fleet.py; docs/serving.md fleet section),
+# pre-merge usable like scripts/serve_smoke.sh: exit 0 = the whole story
+# holds, nonzero = broken. One routed run carries BOTH chaos legs:
+#
+#   1. train 2 steps -> committed checkpoint step 2;
+#   2. start `main.py route`: 3 serving replicas behind the router,
+#      open-loop load, with a seeded p99-regression fault armed for any
+#      replica that reaches checkpoint step 4
+#      (DRT_FAULT_SERVE_SLOW_MS=250@4 — resilience/faultinject.py);
+#   3. SIGKILL one replica mid-load: hedged retries keep client errors
+#      bounded while the watchdog drains -> respawns -> readmits it;
+#   4. resume training to step 4 mid-load: the router canaries the new
+#      checkpoint onto a fraction of the fleet, the fault makes exactly
+#      those replicas slow, and the canary AUTO-ROLLS-BACK — the bad
+#      step never reaches a baseline replica.
+#
+#   scripts/serve_fleet_smoke.sh [workdir]   # default: fresh mktemp dir
+#
+# Runs in ~4-6 minutes on CPU (three replica jax processes + two short
+# training processes).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROOT="${1:-$(mktemp -d /tmp/drt_fleet_smoke.XXXXXX)}"
+echo "fleet smoke workdir: $ROOT"
+
+# seconds-fast shardcheck first (serve_smoke.sh pattern): spec bugs die
+# here, not three minutes into a fleet warm-up
+scripts/analysis_gate.sh --preset smoke
+
+SHRINK=(--preset smoke
+        --set model.resnet_size=8 --set model.compute_dtype=float32
+        --set data.image_size=8 --set train.batch_size=16
+        --set data.eval_batch_size=16
+        --set "log_root=$ROOT" --set "checkpoint.directory=$ROOT/ckpt"
+        --set checkpoint.async_save=false
+        --set checkpoint.save_every_secs=0
+        --set checkpoint.save_every_steps=2)
+
+# 1) train 2 steps -> committed checkpoint step 2 (the fleet's pin)
+env JAX_PLATFORMS=cpu python -m distributed_resnet_tensorflow_tpu.main \
+  "${SHRINK[@]}" --set train.train_steps=2
+
+# 2) the routed fleet under open-loop load, p99-regression fault armed
+# for step 4 (fleet-wide env: only replicas that SWAP to step 4 — the
+# canary fraction — ever become slow; baselines stay pinned at 2)
+env JAX_PLATFORMS=cpu DRT_FAULT_SERVE_SLOW_MS="250@4" \
+  python -m distributed_resnet_tensorflow_tpu.main \
+  route "${SHRINK[@]}" \
+  --set route.replicas=3 \
+  --set route.load_qps=20 --set route.load_duration_secs=90 \
+  --set route.health_interval_secs=0.5 --set route.watch_interval_secs=0.5 \
+  --set route.row_interval_secs=2 \
+  --set route.hedge_ms=300 --set route.attempt_timeout_ms=3000 \
+  --set route.replica_grace_secs=2 \
+  --set route.canary_window_secs=10 --set route.canary_min_samples=10 \
+  --set serve.max_queue_delay_ms=10 --set serve.poll_interval_secs=0.5 \
+  > "$ROOT/route_report.json" &
+ROUTE_PID=$!
+
+# wait for the router's READY marker (all replicas warm behind it)
+for _ in $(seq 1 600); do
+  [[ -f "$ROOT/route/READY" ]] && break
+  kill -0 "$ROUTE_PID" 2>/dev/null || { echo "route process died during startup"; exit 1; }
+  sleep 0.5
+done
+[[ -f "$ROOT/route/READY" ]] || { echo "router never became ready"; kill "$ROUTE_PID"; exit 1; }
+
+# 3) SIGKILL replica 0 mid-load (pid from its READY marker, read BEFORE
+# the respawn rewrites it)
+sleep 3
+R0_PID=$(python -c "import json,sys; print(json.load(open(sys.argv[1]))['pid'])" \
+  "$ROOT/serve-r0/READY")
+echo "fleet smoke: SIGKILL replica 0 (pid $R0_PID)"
+kill -9 "$R0_PID"
+
+# 4) publish checkpoint step 4 mid-load: resume training (the canary
+# target; the armed fault makes exactly the replicas serving it slow)
+env JAX_PLATFORMS=cpu python -m distributed_resnet_tensorflow_tpu.main \
+  "${SHRINK[@]}" --set train.train_steps=4
+
+wait "$ROUTE_PID"
+
+# 5) assertions over the route report + the route / replica streams
+python - "$ROOT" <<'EOF'
+import json, os, sys
+root = sys.argv[1]
+rep = json.loads(open(os.path.join(root, "route_report.json"))
+                 .read().strip().splitlines()[-1])
+router, load = rep["router"], rep["load"]
+
+# bounded client damage: a SIGKILLed replica costs at most a handful of
+# requests (hedge + retry absorb the rest), and the run drains fully
+assert load["offered"] > 500, f"load never ramped: {load}"
+assert load["failed"] + router["errors"] <= 5, \
+    f"client errors not bounded: {load} {router}"
+assert load["unresolved"] == 0, f"undrained requests: {load}"
+
+events = [json.loads(l) for l in
+          open(os.path.join(root, "route", "metrics.jsonl")) if l.strip()]
+by = lambda kind: [e for e in events if e.get("event") == kind]
+
+# the watchdog replaced replica 0: kill -> respawn -> readmit rows
+acts = {e["action"] for e in by("replica_replace") if e.get("replica") == 0}
+assert {"kill", "respawn", "readmit"} <= acts, \
+    f"replica 0 was not replaced end-to-end: {sorted(acts)}"
+assert rep["fleet"]["replaces"] >= 1, rep["fleet"]
+
+# QPS recovered: route rollup rows kept flowing and the fleet ended with
+# every replica routable again
+assert by("route"), "no route rollup rows"
+last_replicas = by("route")[-1]["replicas"]
+ready = [r for r, cell in last_replicas.items()
+         if cell.get("state") in ("ready", "degraded")]
+assert len(ready) == 3, f"fleet did not recover: {last_replicas}"
+
+# the canary started on step 4 and auto-rolled-back on the seeded p99
+# regression; the step is remembered bad and the fleet stayed on 2
+starts = [e for e in by("canary") if e.get("action") == "start"
+          and e.get("step") == 4]
+rollbacks = [e for e in by("canary") if e.get("rollback")
+             and e.get("step") == 4]
+assert starts, "no canary ever started for step 4"
+assert rollbacks, f"canary for step 4 did not roll back: {by('canary')}"
+assert rollbacks[-1].get("reason") in ("p99_regression",
+                                       "confidence_regression"), rollbacks
+assert router["fleet_step"] == 2, f"fleet left step 2: {router}"
+assert 4 in router["bad_steps"], router
+
+# the bad step NEVER reached a baseline replica: only the canary set may
+# show a swap to (or a batch at) step 4
+canary_ids = {int(r) for e in starts for r in e["canary"]}
+assert canary_ids, starts
+for rid in range(3):
+    stream = os.path.join(root, f"serve-r{rid}", "metrics.jsonl")
+    rows = [json.loads(l) for l in open(stream) if l.strip()]
+    hit4 = [r for r in rows
+            if (r.get("event") == "serve_swap" and r.get("to_step") == 4)
+            or (r.get("event") == "serve_batch" and r.get("step") == 4)]
+    if rid not in canary_ids and hit4:
+        raise AssertionError(
+            f"baseline replica {rid} served unvalidated step 4: {hit4[:2]}")
+
+print("fleet smoke OK:", json.dumps({
+    "offered": load["offered"], "failed": load["failed"],
+    "errors": router["errors"], "hedges": router["hedges"],
+    "replaces": rep["fleet"]["replaces"],
+    "canary_rollback_reason": rollbacks[-1].get("reason"),
+    "fleet_step": router["fleet_step"]}))
+EOF

@@ -84,7 +84,12 @@ def test_bucketed_is_bit_identical_to_unbucketed(mesh_cfg):
 
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(data=8),
-    MeshConfig(data=4, fsdp=2),
+    # dp_fsdp re-tiered out of the 870s tier-1 (ISSUE 19, ~13s: two full
+    # trainings on the sharded layout); the dp leg keeps the
+    # overlap-vs-default allclose claim in tier-1 and
+    # test_bucketed_is_bit_identical_to_unbucketed[dp_fsdp] keeps the
+    # fsdp layout pinned — the full (unfiltered) suite runs the cross
+    pytest.param(MeshConfig(data=4, fsdp=2), marks=pytest.mark.slow),
 ], ids=["dp", "dp_fsdp"])
 def test_overlap_matches_default_path_to_float_rounding(mesh_cfg):
     """Against the default XLA-propagation exchange the reduction TREE

@@ -140,7 +140,12 @@ def test_fp16_step_refused_with_reason():
 
 @pytest.mark.parametrize("mesh_cfg,opt,zero1", [
     (MeshConfig(data=8), "momentum", "off"),
-    (MeshConfig(data=4, fsdp=2), "momentum", "off"),
+    # momentum-dp_fsdp re-tiered out of the 870s tier-1 (ISSUE 19,
+    # ~11s): momentum-dp keeps the bf16-vs-f32 oracle claim in tier-1
+    # and the fsdp layout stays pinned by the overlap/zero1 exactness
+    # tests; the full (unfiltered) suite runs the layout cross
+    pytest.param(MeshConfig(data=4, fsdp=2), "momentum", "off",
+                 marks=pytest.mark.slow),
     # lamb_zero1 legs re-tiered out of the 870s tier-1 (ISSUE 13): the
     # momentum legs pin the bf16-vs-f32 oracle; the LAMB×ZeRO-1
     # composition re-runs it with the heaviest optimizer and stays in
@@ -225,6 +230,13 @@ def test_compressed_exchange_bucketing_is_bit_identical(devices):
     np.testing.assert_array_equal(many, one)
 
 
+# re-tiered out of the 870s tier-1 (ISSUE 19, ~14s: two full zero1
+# trainings). Each composed half stays pinned in tier-1 —
+# test_compressed_exchange_bucketing_is_bit_identical (compression ×
+# bucketing, fsdp leg) and test_zero1.py's overlap-bucketing bitwise
+# test (zero1 × bucketing, uncompressed); the full (unfiltered) suite
+# runs the triple composition.
+@pytest.mark.slow
 def test_compressed_exchange_zero1_composition_bit_identical(devices):
     """Compression composed with the ZeRO-1 reduce-scatter AND the
     bucketed param-update all-gather: still bitwise bucket-invariant."""
