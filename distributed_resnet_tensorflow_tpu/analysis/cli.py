@@ -49,6 +49,14 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
                         "committed schedules, the plan_catalog.json "
                         "refresh, and the bandwidth-catalog sanity "
                         "cross-check")
+    p.add_argument("--no-protocol", action="store_true",
+                   help="skip the protocol phase (ISSUE 20): the "
+                        "exhaustive model check of the declared control-"
+                        "plane protocols (elastic reshard barrier, "
+                        "sharded-checkpoint commit, replica health/"
+                        "replace ladder, canary swap pin), the "
+                        "protocol_models.json refresh, and the "
+                        "protocol-drift lint rule")
     p.add_argument("--root", default=None, help=argparse.SUPPRESS)
     # --root scopes the LINT pass to another tree (tests of the exit-code
     # contract run the real CLI over a known-bad fixture repo)
@@ -73,11 +81,15 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
     if not ns.elaborate_only:
         from .lint import run_lint
         rule_names = None
-        if ns.no_hangcheck:
+        if ns.no_hangcheck or ns.no_protocol:
             from . import rules as rules_pkg
-            hang = {m.RULE_NAME for m in rules_pkg.HANGCHECK_RULES}
+            off = set()
+            if ns.no_hangcheck:
+                off |= {m.RULE_NAME for m in rules_pkg.HANGCHECK_RULES}
+            if ns.no_protocol:
+                off |= {m.RULE_NAME for m in rules_pkg.PROTOCOL_RULES}
             rule_names = [m.RULE_NAME for m in rules_pkg.ALL_RULES
-                          if m.RULE_NAME not in hang]
+                          if m.RULE_NAME not in off]
         findings += run_lint(root=ns.root, rule_names=rule_names)
         print(f"lint: {len(findings)} finding(s) "
               f"[{time.perf_counter() - t0:.1f}s]")
@@ -146,6 +158,25 @@ def main_check(argv: Optional[Sequence[str]] = None) -> int:
                 # schedule change, never on a partial or resized run
                 path = write_plan_catalog(plan_doc)
                 print(f"plan-drift: wrote {path}")
+        if not ns.no_protocol:
+            # protocol (docs/static_analysis.md): BFS over every
+            # interleaving of the four declared control-plane protocols
+            # at their small-scope bounds — safety counterexamples and
+            # liveness traps as findings, model inventory as the
+            # committed protocol_models.json artifact
+            from .protocol import run_protocol, write_artifact as write_pm
+            t5 = time.perf_counter()
+            prfs, pm_doc = run_protocol()
+            print(f"protocol: {len(prfs)} finding(s), "
+                  f"{len(pm_doc.get('specs', {}))} protocol(s) "
+                  f"[{time.perf_counter() - t5:.1f}s]")
+            findings += prfs
+            if ns.root is None:
+                # the models live in THIS package's sources, not the
+                # --root tree under lint — a fixture-tree run must not
+                # rewrite the committed inventory
+                path = write_pm(pm_doc)
+                print(f"protocol: wrote {path}")
 
     from .report import format_findings
     print(format_findings(findings, verbose=ns.verbose))

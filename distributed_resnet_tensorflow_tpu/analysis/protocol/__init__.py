@@ -1,0 +1,23 @@
+"""Protocol model checking: declared control-plane state machines,
+exhaustive small-scope interleaving search, runtime trace conformance.
+
+Three consumers share the declarations in ``spec.py``:
+
+  * the gate phase (``main.py check``, ``--no-protocol`` to skip) runs
+    ``checker.run_protocol`` — BFS over every interleaving of each
+    declared model, safety + liveness, committed
+    ``analysis/protocol_models.json`` artifact;
+  * the ``protocol-drift`` lint rule (``analysis/rules/protocol_drift``)
+    resolves the implementation's state/edge/file-name literals against
+    the specs so model and code cannot silently diverge;
+  * the trace replayer (``conformance.py``) validates recorded
+    metrics rows against the declared edges — both chaos smokes run it,
+    so every chaos run doubles as a protocol-conformance witness.
+
+docs/static_analysis.md (protocol models) is the manual.
+"""
+from .checker import (artifact_path, check_model, run_protocol,  # noqa: F401
+                      write_artifact)
+from .conformance import check_rows, check_stream  # noqa: F401
+from .spec import (Model, ProtocolSpec, load_specs,  # noqa: F401
+                   register_spec)

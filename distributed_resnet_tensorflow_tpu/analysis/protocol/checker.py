@@ -1,0 +1,217 @@
+"""Explicit-state model checker for the declared protocol specs.
+
+BFS over EVERY interleaving of the abstract processes' enabled actions
+(crashes, file losses and timeouts included — they are just actions) at
+the spec's small-scope bounds. Stdlib-only, no devices, milliseconds per
+protocol: the state spaces are hundreds to a few thousand states by
+construction, and a model that outgrows ``state_cap`` is itself a
+finding (the small-scope contract is part of the spec).
+
+What gets checked:
+
+  * **safety** — every invariant on every reachable state. A violation
+    reports the SHORTEST action schedule from the initial state (BFS
+    order), not just the bad state: the counterexample trace is the
+    reviewable artifact (``fail → fail → zombie_revive``), anchored at
+    the spec registration's file:line.
+  * **liveness** — ``eventually`` goals via backward reachability on
+    the explored graph (a reachable state from which the goal is
+    UNREACHABLE is a livelock trap; the trace to the trap is the
+    counterexample), ``reachable`` goals via plain forward reachability
+    (the protocol can actually succeed at these bounds).
+
+The committed artifact (``analysis/protocol_models.json``) records the
+per-spec state/transition counts, the invariant inventory, the bounds
+and a fingerprint over the sorted explored states+edges — sorted keys,
+trailing newline, byte-identical across runs like
+``collective_schedules.json``: its diff in review IS the protocol
+change.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from collections import deque
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from ..report import Finding
+from .spec import Model, ProtocolSpec, load_specs
+
+RULE_NAME = "protocol-model"
+
+#: a declared-small-scope model must stay small; blowing this cap is a
+#: spec bug (unbounded counter in the state), reported as a finding
+STATE_CAP = 200_000
+
+
+def _trace(parent: Dict[tuple, Optional[Tuple[tuple, str]]],
+           state: tuple) -> List[str]:
+    """Reconstruct the action schedule init -> state."""
+    labels: List[str] = []
+    cur: Optional[tuple] = state
+    while cur is not None:
+        link = parent[cur]
+        if link is None:
+            break
+        cur, label = link
+        labels.append(label)
+    return labels[::-1]
+
+
+def _trace_detail(parent, state: tuple) -> str:
+    labels = _trace(parent, state)
+    return ("schedule: " + (" -> ".join(labels) or "<initial state>")
+            + f"\nfinal state: {state!r}")
+
+
+def check_model(spec: ProtocolSpec,
+                mutations: FrozenSet[str] = frozenset(),
+                state_cap: int = STATE_CAP
+                ) -> Tuple[List[Finding], dict]:
+    """Exhaustively explore one spec's model; returns (findings, stats).
+
+    ``mutations`` names guard-weakenings from ``spec.mutations`` — the
+    seeded-bug legs tests use to prove the checker catches the class of
+    bug each guard exists to prevent.
+    """
+    unknown = mutations - set(spec.mutations)
+    if unknown:
+        raise ValueError(f"{spec.name}: unknown mutation(s) "
+                         f"{sorted(unknown)}; declared: {spec.mutations}")
+    model: Model = spec.model(frozenset(mutations))
+    findings: List[Finding] = []
+    violated: set = set()   # invariant names already reported (shortest wins)
+
+    parent: Dict[tuple, Optional[Tuple[tuple, str]]] = {model.init: None}
+    edges: List[Tuple[tuple, str, tuple]] = []
+    queue: deque = deque([model.init])
+
+    def _check_safety(state: tuple) -> None:
+        for name, inv in model.invariants:
+            if name not in violated and not inv(state):
+                violated.add(name)
+                labels = _trace(parent, state)
+                findings.append(Finding(
+                    RULE_NAME, spec.path, spec.line,
+                    f"{spec.name}: safety invariant '{name}' violated "
+                    f"after {len(labels)} action(s): "
+                    + (" -> ".join(labels) or "<initial state>"),
+                    _trace_detail(parent, state)))
+
+    _check_safety(model.init)
+    truncated = False
+    while queue:
+        state = queue.popleft()
+        nexts = sorted(model.actions(state), key=lambda a: (a[0], repr(a[1])))
+        for label, s2 in nexts:
+            edges.append((state, label, s2))
+            if s2 not in parent:
+                if len(parent) >= state_cap:
+                    truncated = True
+                    queue.clear()
+                    break
+                parent[s2] = (state, label)
+                _check_safety(s2)
+                queue.append(s2)
+    if truncated:
+        findings.append(Finding(
+            RULE_NAME, spec.path, spec.line,
+            f"{spec.name}: model exceeded the {state_cap}-state small-"
+            f"scope cap — tighten the declared bounds {dict(spec.bounds)} "
+            "(an unbounded counter in the state defeats exhaustive "
+            "search)"))
+
+    if not truncated:
+        reachable = set(parent)
+        for name, kind, goal in model.liveness:
+            goal_states = {s for s in reachable if goal(s)}
+            if kind == "reachable":
+                if not goal_states:
+                    findings.append(Finding(
+                        RULE_NAME, spec.path, spec.line,
+                        f"{spec.name}: liveness goal '{name}' is "
+                        "UNREACHABLE at the declared bounds — the "
+                        "protocol can never succeed in this model"))
+                continue
+            # 'eventually': backward closure of the goal set; any
+            # reachable state outside it can never reach the goal again
+            pred: Dict[tuple, List[tuple]] = {s: [] for s in reachable}
+            for src, _, dst in edges:
+                pred[dst].append(src)
+            closure = set(goal_states)
+            frontier = deque(goal_states)
+            while frontier:
+                s = frontier.popleft()
+                for p in pred[s]:
+                    if p not in closure:
+                        closure.add(p)
+                        frontier.append(p)
+            traps = reachable - closure
+            if traps:
+                # report the BFS-shallowest trap (deterministic)
+                trap = min(traps, key=lambda s: (len(_trace(parent, s)),
+                                                 repr(s)))
+                labels = _trace(parent, trap)
+                findings.append(Finding(
+                    RULE_NAME, spec.path, spec.line,
+                    f"{spec.name}: liveness goal '{name}' has a trap — "
+                    f"after {' -> '.join(labels) or '<initial state>'} "
+                    "the goal is unreachable on every continuation",
+                    _trace_detail(parent, trap)))
+
+    digest = hashlib.sha256()
+    for line in sorted(repr(s) for s in parent):
+        digest.update(line.encode("utf-8"))
+        digest.update(b"\n")
+    for line in sorted(f"{s!r} --{label}--> {s2!r}"
+                       for s, label, s2 in edges):
+        digest.update(line.encode("utf-8"))
+        digest.update(b"\n")
+    stats = {
+        "states": len(parent),
+        "transitions": len(edges),
+        "fingerprint": "sha256:" + digest.hexdigest(),
+        "truncated": truncated,
+    }
+    return findings, stats
+
+
+def run_protocol() -> Tuple[List[Finding], dict]:
+    """The gate phase: check every registered spec (clean models, no
+    mutations) and build the artifact document."""
+    findings: List[Finding] = []
+    specs_doc: Dict[str, dict] = {}
+    for spec in load_specs():
+        fs, stats = check_model(spec)
+        findings += fs
+        specs_doc[spec.name] = {
+            "title": spec.title,
+            "modules": list(spec.modules),
+            "bounds": dict(spec.bounds),
+            "safety": list(spec.safety_names()),
+            "liveness": list(spec.liveness_names()),
+            "mutations": list(spec.mutations),
+            "declared_at": f"{spec.path}:{spec.line}",
+            **stats,
+        }
+    return findings, {"schema_version": 1, "specs": specs_doc}
+
+
+def artifact_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "protocol_models.json")
+
+
+def write_artifact(doc: dict, path: Optional[str] = None) -> str:
+    """Commit the model inventory — sorted keys, fixed layout, trailing
+    newline: byte-identical across runs (the fingerprints make any
+    model change a reviewable diff)."""
+    if path is None:
+        path = artifact_path()
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,291 @@
+"""Runtime trace conformance: replay recorded event rows against the
+declared protocol state machines.
+
+Every chaos run doubles as a protocol-conformance witness: the rows the
+fleet/elastic machinery writes to ``metrics.jsonl`` (``replica_health``,
+``replica_replace``, ``canary``, ``reshard``, ``mesh_generation``,
+``ckpt_shard``) are validated edge-by-edge against the ``event_edges``
+tables the specs declare (analysis/protocol/spec.py) — an edge the model
+does not allow is a finding at the stream's file:line, whether it came
+from a live run, a smoke, or a test fixture.
+
+Wired into ``scripts/serve_fleet_smoke.sh`` and ``scripts/chaos_smoke.sh
+--elastic`` as::
+
+    python -m distributed_resnet_tensorflow_tpu.analysis.protocol.conformance \
+        <log_root>/route/metrics.jsonl <log_root>/serve-r*/metrics.jsonl
+
+plus a ``--self-test-illegal-edge`` leg that appends a synthetic
+``dead -> ready`` health row and exits 0 only if the checker catches it
+— the smoke proves the witness can actually fail.
+
+Torn lines (a crash or rotation mid-write) are skipped like the monitor
+does; rows of undeclared event kinds are ignored. Chain continuity for
+``replica_health`` tolerates a restart back to the declared initial
+state (a fresh health object after a stream rotation).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..report import Finding
+from .spec import load_specs
+
+RULE_NAME = "protocol-trace"
+
+
+def _tables() -> Dict[str, dict]:
+    merged: Dict[str, dict] = {}
+    for spec in load_specs():
+        for kind, table in spec.event_edges.items():
+            merged[kind] = dict(table, spec=spec.name)
+    return merged
+
+
+class _Replay:
+    """Stateful per-stream replayer; one instance per file so
+    cross-stream interleaving never manufactures false edges."""
+
+    def __init__(self, source: str):
+        self.source = source
+        self.tables = _tables()
+        self.findings: List[Finding] = []
+        self._health_last: Dict[object, str] = {}      # replica -> to-state
+        self._ladder: Dict[object, str] = {}           # replica -> rung
+        self._canary_active: Optional[int] = None
+        self._last_generation: Dict[str, int] = {}     # event kind -> gen
+        self._ckpt_last: Dict[object, int] = {}        # process -> committed
+
+    def _bad(self, line: int, msg: str) -> None:
+        self.findings.append(Finding(RULE_NAME, self.source, line, msg))
+
+    # -- replica_health ----------------------------------------------------
+    def _replica_health(self, line: int, row: dict, table: dict) -> None:
+        frm, to = row.get("from"), row.get("to")
+        reason = row.get("reason")
+        rid = row.get("replica")
+        if (frm, to, reason) not in table["edges"]:
+            self._bad(line, f"undeclared replica_health edge "
+                            f"{frm!r} -> {to!r} ({reason!r}) for replica "
+                            f"{rid} — not in the declared health state "
+                            f"machine ({table['spec']})")
+            return
+        last = self._health_last.get(rid)
+        if last is not None and frm != last and frm != table["initial"]:
+            self._bad(line, f"replica_health chain break for replica "
+                            f"{rid}: row leaves {frm!r} but the previous "
+                            f"row landed in {last!r}")
+        self._health_last[rid] = to
+
+    # -- replica_replace ---------------------------------------------------
+    def _replica_replace(self, line: int, row: dict, table: dict) -> None:
+        action, rid = row.get("action"), row.get("replica")
+        reason = row.get("reason")
+        if action not in table["actions"]:
+            self._bad(line, f"undeclared replica_replace action "
+                            f"{action!r} for replica {rid}")
+            return
+        if reason is not None and reason not in table["reasons"]:
+            self._bad(line, f"undeclared replica_replace reason "
+                            f"{reason!r} for replica {rid}")
+        rung = self._ladder.get(rid, "watching")
+        ladder = table["ladder"]          # ("kill", "respawn", "readmit")
+        if rung == "gave_up":
+            self._bad(line, f"replica_replace {action!r} for replica "
+                            f"{rid} after gave_up (the ladder is "
+                            "terminal)")
+            return
+        if action == "gave_up":
+            self._ladder[rid] = "gave_up"
+            return
+        expect = {"watching": ladder[0], ladder[0]: ladder[1],
+                  ladder[1]: ladder[2]}.get(rung)
+        if action != expect:
+            self._bad(line, f"replica_replace ladder violation for "
+                            f"replica {rid}: {action!r} while at rung "
+                            f"{rung!r} (declared order "
+                            f"{' -> '.join(ladder)})")
+        self._ladder[rid] = "watching" if action == ladder[2] else action
+
+    # -- canary ------------------------------------------------------------
+    def _canary(self, line: int, row: dict, table: dict) -> None:
+        action, step = row.get("action"), row.get("step")
+        reason = row.get("reason")
+        if action not in table["actions"]:
+            self._bad(line, f"undeclared canary action {action!r}")
+            return
+        allowed = table["reasons_by_action"].get(action)
+        if reason is not None and allowed is not None \
+                and reason not in allowed:
+            self._bad(line, f"undeclared canary reason {reason!r} for "
+                            f"action {action!r}")
+        if action == "start":
+            if self._canary_active is not None:
+                self._bad(line, f"canary start for step {step} while "
+                                f"step {self._canary_active} is still "
+                                "undecided (one canary at a time)")
+            self._canary_active = step
+            return
+        # promote / rollback
+        if self._canary_active is None:
+            if not (action == "promote" and reason == "single_replica"):
+                self._bad(line, f"canary {action!r} for step {step} "
+                                "without a preceding start")
+        elif step != self._canary_active:
+            self._bad(line, f"canary {action!r} for step {step} but the "
+                            f"active canary is step "
+                            f"{self._canary_active}")
+        self._canary_active = None
+
+    # -- reshard / mesh_generation ----------------------------------------
+    def _reshard(self, line: int, row: dict, table: dict) -> None:
+        reason = row.get("reason")
+        if reason not in table["reasons"]:
+            self._bad(line, f"undeclared reshard reason {reason!r}")
+        old, new = row.get("old_hosts"), row.get("new_hosts")
+        if isinstance(old, int) and isinstance(new, int):
+            if reason == "peer_lost" and not new < old:
+                self._bad(line, f"reshard peer_lost must shrink the "
+                                f"mesh: old_hosts={old} new_hosts={new}")
+            if reason == "grow" and not new > old:
+                self._bad(line, f"reshard grow must grow the mesh: "
+                                f"old_hosts={old} new_hosts={new}")
+        rs = row.get("restore_step")
+        if isinstance(rs, int) and rs < -1:
+            self._bad(line, f"reshard restore_step {rs} (< -1; -1 means "
+                            "fresh init, committed steps are >= 0)")
+        self._generation_monotonic(line, row, "reshard")
+
+    def _mesh_generation(self, line: int, row: dict, table: dict) -> None:
+        self._generation_monotonic(line, row, "mesh_generation")
+
+    def _generation_monotonic(self, line: int, row: dict,
+                              kind: str) -> None:
+        gen = row.get("generation")
+        if not isinstance(gen, int):
+            return
+        last = self._last_generation.get(kind)
+        if last is not None and gen <= last:
+            self._bad(line, f"{kind} generation went {last} -> {gen}; "
+                            "generations only ever advance")
+        self._last_generation[kind] = gen
+
+    # -- ckpt_shard --------------------------------------------------------
+    def _ckpt_shard(self, line: int, row: dict, table: dict) -> None:
+        proc = row.get("process")
+        last = row.get("last_committed_step")
+        if isinstance(last, int):
+            if last < -1:
+                self._bad(line, f"ckpt_shard last_committed_step {last}")
+            prev = self._ckpt_last.get(proc)
+            if prev is not None and last < prev:
+                self._bad(line, f"ckpt_shard last_committed_step went "
+                                f"{prev} -> {last} for process {proc}; "
+                                "a committed step never un-commits")
+            self._ckpt_last[proc] = last
+
+    _HANDLERS = {
+        "replica_health": _replica_health,
+        "replica_replace": _replica_replace,
+        "canary": _canary,
+        "reshard": _reshard,
+        "mesh_generation": _mesh_generation,
+        "ckpt_shard": _ckpt_shard,
+    }
+
+    def feed(self, line: int, row: dict) -> None:
+        kind = row.get("event")
+        handler = self._HANDLERS.get(kind)
+        if handler is not None and kind in self.tables:
+            handler(self, line, row, self.tables[kind])
+
+
+def check_rows(rows: Iterable[Tuple[int, dict]],
+               source: str = "<rows>") -> List[Finding]:
+    """Validate ``(lineno, row)`` pairs from one stream."""
+    replay = _Replay(source)
+    for line, row in rows:
+        replay.feed(line, row)
+    return replay.findings
+
+
+def read_stream(path: str) -> List[Tuple[int, dict]]:
+    """Parse one metrics.jsonl (or rotated segment), skipping torn
+    lines the way telemetry/monitor.py does."""
+    out: List[Tuple[int, dict]] = []
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for i, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                row = json.loads(raw)
+            except ValueError:
+                continue   # torn mid-write (crash/rotation) — skip
+            if isinstance(row, dict):
+                out.append((i, row))
+    return out
+
+
+def check_stream(path: str) -> List[Finding]:
+    """Replay one stream file; a rotated sibling ``<path>.1`` is
+    prepended so a protocol round spanning a rotation replays whole."""
+    import os
+    rows: List[Tuple[int, dict]] = []
+    if os.path.exists(path + ".1"):
+        rows += read_stream(path + ".1")
+    rows += read_stream(path)
+    return check_rows(rows, source=os.path.relpath(path))
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+    from ..report import format_findings
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_resnet_tensorflow_tpu.analysis."
+             "protocol.conformance",
+        description="replay metrics.jsonl rows against the declared "
+                    "protocol state machines (docs/static_analysis.md)")
+    ap.add_argument("streams", nargs="+", help="metrics.jsonl paths")
+    ap.add_argument("--self-test-illegal-edge", action="store_true",
+                    help="append a synthetic dead->ready health row to "
+                         "the first stream's rows and exit 0 only if "
+                         "the checker catches it (the smoke's witness-"
+                         "can-fail leg)")
+    ns = ap.parse_args(argv)
+    if ns.self_test_illegal_edge:
+        rows = read_stream(ns.streams[0])
+        seeded_line = (rows[-1][0] if rows else 0) + 1
+        rows.append((seeded_line, {
+            "event": "replica_health", "replica": 0,
+            "from": "dead", "to": "ready", "reason": "probe_ok"}))
+        findings = check_rows(rows, source=os.path.relpath(ns.streams[0]))
+        caught = [f for f in findings if f.line == seeded_line]
+        if caught:
+            print("self-test: seeded illegal edge caught:\n"
+                  + format_findings(caught))
+            return 0
+        print("self-test FAILED: the seeded dead->ready edge was not "
+              "flagged")
+        return 1
+    findings: List[Finding] = []
+    n_rows = 0
+    for path in ns.streams:
+        rows: List[Tuple[int, dict]] = []
+        if os.path.exists(path + ".1"):
+            rows += read_stream(path + ".1")
+        rows += read_stream(path)
+        n_rows += len(rows)
+        findings += check_rows(rows, source=os.path.relpath(path))
+    print(f"protocol-trace: {len(findings)} finding(s) over "
+          f"{n_rows} row(s) in {len(ns.streams)} stream(s)")
+    if findings:
+        print(format_findings(findings, verbose=True))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,146 @@
+"""Declared control-plane protocol models.
+
+The repo runs four hand-rolled distributed protocols — the elastic
+reshard barrier, the crash-consistent sharded-checkpoint commit, the
+replica health/replace ladder, and the canary swap-control pin — and
+each had been verified only by example-based chaos smokes that explore a
+handful of interleavings. This module is the declaration side of the
+protocol checker (docs/static_analysis.md, protocol models): every
+protocol registers a :class:`ProtocolSpec` **co-located with its
+implementation** (the way ``THREAD_ROLES`` and ``EVENT_SCHEMAS`` are),
+carrying
+
+  * the abstract state machine itself (:class:`Model`): an initial
+    state, an enabled-actions function (including crash/restart and
+    message/file-loss actions), safety invariants, and liveness goals —
+    explored exhaustively by ``analysis/protocol/checker.py`` over ALL
+    interleavings at declared small-scope bounds;
+  * the declared runtime edge tables (``event_edges``) the trace
+    conformance replayer validates recorded metrics rows against;
+  * the implementation literals (state strings, marker-file names,
+    control-file fields) the ``protocol-drift`` lint rule resolves
+    against the modeled source files, so the model cannot silently
+    diverge from the code it models;
+  * the seeded mutations the spec supports — named guard-weakenings
+    (a dropped commit-marker wait, an illegal health edge, a blind
+    commit overwrite) that tests inject to prove the checker actually
+    catches the bug class the guard exists to prevent.
+
+Everything here is stdlib-only and import-light: implementation modules
+import THIS module at load time (to register their spec), and the
+checker imports the implementation modules lazily via
+:func:`load_specs`.
+
+Model states must be hashable trees of primitives with a deterministic
+``repr`` (tuples, strings, ints, bools, None — no sets/frozensets):
+the committed ``protocol_models.json`` fingerprint hashes sorted state
+and edge reprs and must be byte-identical across runs.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Iterable, Mapping, Tuple
+
+#: liveness kinds — ``eventually`` means "from EVERY reachable state a
+#: goal state stays reachable" (no livelock traps; fairness-free, so an
+#: unfair retry loop does not count as a violation), ``reachable`` means
+#: "some schedule reaches the goal from the initial state" (the protocol
+#: CAN succeed at these bounds, e.g. a commit actually happens).
+LIVENESS_KINDS = ("eventually", "reachable")
+
+
+@dataclass(frozen=True)
+class Model:
+    """One explorable protocol instance at fixed small-scope bounds.
+
+    ``actions(state)`` returns every enabled ``(label, next_state)``
+    pair; the checker owns the interleaving (it tries them all).
+    ``invariants`` are safety properties — ``fn(state) -> True`` on
+    every reachable state or the shortest violating action schedule is
+    the counterexample. ``liveness`` entries are
+    ``(name, kind, goal_fn)`` with ``kind`` in :data:`LIVENESS_KINDS`.
+    """
+
+    init: tuple
+    actions: Callable[[tuple], Iterable[Tuple[str, tuple]]]
+    invariants: Tuple[Tuple[str, Callable[[tuple], bool]], ...] = ()
+    liveness: Tuple[Tuple[str, str, Callable[[tuple], bool]], ...] = ()
+
+
+@dataclass(frozen=True)
+class ProtocolSpec:
+    """A declared protocol: model factory + conformance tables + the
+    literals binding it to the implementation it models."""
+
+    name: str
+    title: str
+    #: repo-relative implementation files this spec models (the
+    #: protocol-drift rule resolves ``literals`` against their source)
+    modules: Tuple[str, ...]
+    #: small-scope bounds the model is exhaustive at (documentation +
+    #: artifact inventory; the model factory bakes them in)
+    bounds: Mapping[str, int]
+    #: mutations -> Model; a frozenset of names from ``mutations``
+    #: weakens the matching guards (seeded-bug legs)
+    model: Callable[[FrozenSet[str]], Model]
+    #: seeded guard-weakenings the model factory understands
+    mutations: Tuple[str, ...] = ()
+    #: event kind -> declared runtime-conformance table (see
+    #: analysis/protocol/conformance.py for the per-kind shapes)
+    event_edges: Mapping[str, Mapping] = field(default_factory=dict)
+    #: implementation literal -> human description; each literal must
+    #: appear in at least one of ``modules``'s sources
+    literals: Mapping[str, str] = field(default_factory=dict)
+    #: ((event, field, values), ...) cross-checked against the declared
+    #: enum inventory in utils/metrics.EVENT_SCHEMAS field descriptions
+    enum_checks: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = ()
+    #: registration site (filled by :func:`register_spec`) — findings
+    #: about this spec anchor here
+    path: str = ""
+    line: int = 0
+
+    def safety_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.model(frozenset()).invariants)
+
+    def liveness_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _, _ in self.model(frozenset()).liveness)
+
+
+_REGISTRY: Dict[str, ProtocolSpec] = {}
+
+#: the modules that register specs at import time — co-located with the
+#: protocol implementations they model (ISSUE 20 tentpole)
+_SPEC_MODULES = (
+    "distributed_resnet_tensorflow_tpu.resilience.elastic",
+    "distributed_resnet_tensorflow_tpu.checkpoint.shards",
+    "distributed_resnet_tensorflow_tpu.serve.fleet",
+    "distributed_resnet_tensorflow_tpu.serve.swap",
+)
+
+
+def _repo_root() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.dirname(pkg)
+
+
+def register_spec(spec: ProtocolSpec) -> ProtocolSpec:
+    """Register a spec, stamping the caller's file:line as the anchor
+    every checker/lint finding about this protocol points at."""
+    frame = sys._getframe(1)
+    rel = os.path.relpath(frame.f_code.co_filename, _repo_root())
+    stamped = ProtocolSpec(**{**spec.__dict__,
+                              "path": rel, "line": frame.f_lineno})
+    _REGISTRY[stamped.name] = stamped
+    return stamped
+
+
+def load_specs() -> Tuple[ProtocolSpec, ...]:
+    """Import the co-located spec registrations and return every
+    declared protocol, sorted by name (deterministic artifact order)."""
+    import importlib
+    for mod in _SPEC_MODULES:
+        importlib.import_module(mod)
+    return tuple(_REGISTRY[name] for name in sorted(_REGISTRY))

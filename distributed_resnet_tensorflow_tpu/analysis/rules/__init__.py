@@ -9,7 +9,8 @@ docs/static_analysis.md.
 """
 from . import (bare_assert, blocking_call, cached_mesh, chief_collective,
                ckpt_io, device_put, exit_codes, lock_order, opt_state,
-               precision_cast, registry_drift, thread_dispatch)
+               precision_cast, protocol_drift, registry_drift,
+               thread_dispatch)
 
 ALL_RULES = (
     device_put,
@@ -24,6 +25,7 @@ ALL_RULES = (
     blocking_call,
     chief_collective,
     lock_order,
+    protocol_drift,
 )
 
 #: the hangcheck thread/lock contract rules (ISSUE 13) — ``main.py check
@@ -33,4 +35,11 @@ HANGCHECK_RULES = (
     blocking_call,
     chief_collective,
     lock_order,
+)
+
+#: the protocol-model conformance rules (ISSUE 20) — ``main.py check
+#: --no-protocol`` excludes these alongside skipping the model-checking
+#: phase itself
+PROTOCOL_RULES = (
+    protocol_drift,
 )

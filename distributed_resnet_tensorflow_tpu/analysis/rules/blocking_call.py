@@ -16,6 +16,24 @@ reachable zero-argument ``.get()`` / ``.wait()`` / ``.join()`` (no
 ``str.join(xs)``, ``os.path.join(a, b)`` all carry arguments; the
 blocking signatures bare of arguments are the queue/event/thread forms.
 
+The socket sweep (ISSUE 20 satellite): the fleet front door added the
+largest thread inventory since the rule landed — the replica listener's
+accept/connection threads and the router's per-replica client pool
+(serve/wire.py) all park on sockets, where "untimed" means
+``socket.recv``/``accept`` on a socket that never got a ``settimeout``.
+Those calls carry arguments, so the zero-arg discriminator above never
+sees them; instead the sweep roots at EVERY registered thread spawn
+target (any role — a daemon parked forever on a dead peer's socket still
+leaks a thread and wedges ``close()``/``join``) plus the loop roots, and
+flags reachable socket waits unless a deadline is established for the
+root: a ``.settimeout(<not None>)`` anywhere in the root's reachable
+call graph, or in a class-sibling method of a reachable method (the
+listener arms the accept timeout in ``start()`` BEFORE spawning
+``_accept_loop``; the connection handler arms the conn timeout before
+``_recv_exact`` parks on it). Root-level blessing is deliberately
+coarse — the contract is "this thread's sockets live under deadlines",
+not a per-call dataflow proof.
+
 Regression notes (findings this rule surfaced on the real tree, fixed in
 the same round it landed):
 
@@ -42,6 +60,31 @@ RULE_NAME = "untimed-blocking-call"
 DOC = __doc__
 
 _BLOCKING_ATTRS = ("get", "wait", "join")
+
+#: attribute calls that park on a socket (or a multiprocessing pipe —
+#: ``Connection.recv`` blocks the same way) until the peer speaks
+_SOCKET_WAIT_ATTRS = ("accept", "recv", "recv_into", "recvfrom")
+
+
+def _socket_wait(call: ast.Call) -> "str | None":
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _SOCKET_WAIT_ATTRS:
+        return fn.attr
+    return None
+
+
+def _arms_deadline(fn_node: ast.AST) -> bool:
+    """True when the function calls ``<obj>.settimeout(x)`` with ``x``
+    not literally None — ``settimeout(None)`` DISARMS the deadline and
+    must not count as arming one."""
+    for node in body_walk(fn_node):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "settimeout" and node.args:
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and arg.value is None):
+                return True
+    return False
 
 
 def _untimed_blocking(call: ast.Call) -> bool:
@@ -89,3 +132,49 @@ def check(ctx) -> Iterable[Finding]:
                     "thread forever; use a timed wait that re-checks "
                     "liveness and fails loudly "
                     "(docs/static_analysis.md hangcheck)")
+
+    # -- socket sweep: waits on sockets reachable from ANY thread root
+    # (listener accept/connection threads, router client pool, daemons) —
+    # arguments or not, a recv on a socket with no armed settimeout parks
+    # the thread until the peer speaks, which a dead peer never does.
+    socket_roots = set(roots)
+    for spawn in threads_mod.iter_spawn_sites(ctx):
+        if spawn.target is not None:
+            socket_roots.add(spawn.target.key)
+    emitted = set()
+    for root in sorted(socket_roots):
+        reach = sorted(graph.reachable([root]))
+        blessed = any(_arms_deadline(graph.funcs[k].node) for k in reach)
+        if not blessed:
+            # class-sibling blessing: the deadline is often armed in a
+            # lifecycle method OUTSIDE the thread body — the listener's
+            # start() calls self._sock.settimeout(...) before spawning
+            # _accept_loop — so any settimeout in a class that owns a
+            # reachable method blesses the root too
+            classes = {(graph.funcs[k].rel, graph.funcs[k].cls)
+                       for k in reach if graph.funcs[k].cls is not None}
+            blessed = any(
+                fn.cls is not None and (fn.rel, fn.cls) in classes and
+                _arms_deadline(fn.node)
+                for fn in graph.funcs.values())
+        if blessed:
+            continue
+        for key in reach:
+            fn = graph.funcs[key]
+            for node in body_walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                attr = _socket_wait(node)
+                if attr is None:
+                    continue
+                mark = (fn.rel, node.lineno, attr)
+                if mark in emitted:
+                    continue
+                emitted.add(mark)
+                yield Finding(
+                    RULE_NAME, fn.rel, node.lineno,
+                    f"socket .{attr}() reachable from a thread root with "
+                    "no .settimeout(...) armed anywhere on its path — a "
+                    "dead peer parks this thread forever and close()/"
+                    "join wedges behind it; arm a deadline before the "
+                    "loop (docs/static_analysis.md hangcheck)")

@@ -1,18 +1,29 @@
 """exit-code-contract: process exit codes come from the declared registry.
 
 Launchers key requeue-vs-fail decisions off exit codes (docs/resilience.md:
-0 = done, 75 = resumable/requeue, 1 = real failure). A stray
-``sys.exit(3)`` silently breaks that protocol — SLURM would treat a
+0 = done, 75 = resumable/requeue, 1 = real failure, 130 = operator ^C). A
+stray ``sys.exit(3)`` silently breaks that protocol — SLURM would treat a
 resumable condition as a hard failure or vice versa. This rule flags any
-``sys.exit``/``os._exit`` whose argument is an integer literal not in
-``resilience.EXIT_CONTRACT``. Named constants (RESUMABLE_EXIT_CODE,
-FAILURE_EXIT_CODE) and computed codes (exit-code pass-through in
-launchers) are accepted — the contract is about new literals.
+integer literal outside ``resilience.EXIT_CONTRACT`` that becomes a
+process exit code by any of three routes:
+
+  * a direct ``sys.exit(<n>)`` / ``os._exit(<n>)`` call;
+  * a ``raise SystemExit(<n>)`` (the same call in exception clothing);
+  * an **exit-flow function**: when ``sys.exit(f(...))`` appears, ``f``'s
+    returned literals ARE exit codes — both ``return <n>`` and
+    ``name = <n>`` where ``name`` is returned (the launch.py
+    ``rc = 130; ...; return rc`` shape that hid from the original rule),
+    followed one call level deep (``return g(...)`` inside ``f`` makes
+    ``g`` exit-flow too, same module only).
+
+Named constants (RESUMABLE_EXIT_CODE, INTERRUPT_EXIT_CODE, ...) and
+computed codes (exit-code pass-through in launchers) are accepted — the
+contract is about new literals.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterable
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from ..report import Finding
 
@@ -33,24 +44,128 @@ def _is_exit_call(node: ast.Call) -> bool:
     return False
 
 
+def _is_system_exit_raise(node: ast.Raise) -> Optional[ast.Call]:
+    exc = node.exc
+    if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name) \
+            and exc.func.id == "SystemExit":
+        return exc
+    return None
+
+
+def _int_literal(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _called_name(node: ast.AST) -> Optional[str]:
+    """Bare function name of a same-module call: ``f(...)`` -> "f"."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def _walk_same_scope(fn: ast.FunctionDef) -> Iterable[ast.AST]:
+    """Walk ``fn``'s body WITHOUT descending into nested function
+    definitions (a closure's returns are not the enclosing function's
+    exit codes)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _exit_flow_literals(fn: ast.FunctionDef
+                        ) -> Tuple[List[Tuple[int, int]], Set[str]]:
+    """(literal exit codes flowing out of ``fn`` as ``(code, lineno)``,
+    names of same-module functions whose return value ``fn`` returns).
+
+    A literal flows out via ``return <n>`` directly, or via
+    ``name = <n>`` when some ``return name`` exists in the function —
+    an over-approximation (the assignment might be dead by the return)
+    that is exactly right for a lint: an undeclared literal sitting in
+    an exit-code variable is the bug whether or not today's control
+    flow reaches it.
+    """
+    body = list(_walk_same_scope(fn))   # nested defs keep their own story
+    returned_names: Set[str] = set()
+    callees: Set[str] = set()
+    for node in body:
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Name):
+                returned_names.add(node.value.id)
+            name = _called_name(node.value)
+            if name is not None:
+                callees.add(name)
+    out: List[Tuple[int, int]] = []
+    for node in body:
+        if isinstance(node, ast.Return) and node.value is not None:
+            lit = _int_literal(node.value)
+            if lit is not None:
+                out.append((lit, node.lineno))
+        elif isinstance(node, ast.Assign):
+            lit = _int_literal(node.value)
+            if lit is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id in returned_names:
+                    out.append((lit, node.lineno))
+    return out, callees
+
+
 def check(ctx) -> Iterable[Finding]:
     codes = _contract_codes()
     for sf in ctx.all_python():
         if sf.tree is None:
             continue
+        funcs: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in ast.walk(sf.tree)
+            if isinstance(n, ast.FunctionDef)}
+        exit_args: List[ast.AST] = []
         for node in ast.walk(sf.tree):
-            if not (isinstance(node, ast.Call) and _is_exit_call(node)):
-                continue
-            if not node.args:
-                continue
-            arg = node.args[0]
-            if isinstance(arg, ast.Constant) and \
-                    isinstance(arg.value, int) and \
-                    not isinstance(arg.value, bool) and \
-                    arg.value not in codes:
+            if isinstance(node, ast.Call) and _is_exit_call(node) \
+                    and node.args:
+                exit_args.append(node.args[0])
+            elif isinstance(node, ast.Raise):
+                exc = _is_system_exit_raise(node)
+                if exc is not None and exc.args:
+                    exit_args.append(exc.args[0])
+
+        # (a) direct literals handed to sys.exit/os._exit/SystemExit
+        for arg in exit_args:
+            lit = _int_literal(arg)
+            if lit is not None and lit not in codes:
                 yield Finding(
-                    RULE_NAME, sf.rel, node.lineno,
-                    f"exit code {arg.value} is not in the declared "
-                    f"contract {sorted(codes)} (resilience.EXIT_CONTRACT) "
-                    "— launchers cannot classify it; declare it or reuse "
+                    RULE_NAME, sf.rel, arg.lineno,
+                    f"exit code {lit} is not in the declared contract "
+                    f"{sorted(codes)} (resilience.EXIT_CONTRACT) — "
+                    "launchers cannot classify it; declare it or reuse "
                     "an existing code")
+
+        # (b) literals flowing out of exit-flow functions:
+        # sys.exit(f(...)) makes every literal f returns an exit code
+        roots = {name for arg in exit_args
+                 if (name := _called_name(arg)) is not None}
+        seen: Set[str] = set()
+        frontier = [n for n in roots if n in funcs]
+        while frontier:
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            literals, callees = _exit_flow_literals(funcs[name])
+            for lit, lineno in literals:
+                if lit not in codes:
+                    yield Finding(
+                        RULE_NAME, sf.rel, lineno,
+                        f"exit code {lit} flows out of {name}() into a "
+                        f"sys.exit(...) but is not in the declared "
+                        f"contract {sorted(codes)} "
+                        "(resilience.EXIT_CONTRACT) — launchers cannot "
+                        "classify it; declare it or reuse an existing "
+                        "code")
+            frontier += [c for c in callees if c in funcs and c not in seen]

@@ -1,0 +1,100 @@
+"""protocol-drift: the declared protocol specs must match the code.
+
+The protocol models (analysis/protocol/, docs/static_analysis.md) are
+only worth checking if they stay bound to the implementations they
+model. Three resolutions per registered :class:`ProtocolSpec`:
+
+  * every declared implementation **literal** (health-state string,
+    marker-file name, control-file field, round-file prefix) must still
+    appear in at least one of the spec's declared source modules — a
+    rename in code without a spec update is exactly the silent
+    divergence that turns an exhaustive checker into false confidence;
+  * every declared source **module** must still exist in the tree
+    (a moved/renamed file orphans the spec);
+  * every **enum_check** must agree with the declared event inventory:
+    the pipe-list in the matching ``utils.metrics.EVENT_SCHEMAS`` field
+    description (``"... (probe_ok | failures | ...)"``) is parsed and
+    set-compared against the spec's transition-reason/action/state
+    vocabulary, and every event kind the spec's ``event_edges`` table
+    replays must be a declared event.
+
+Specs whose own registration file is absent from the linted tree are
+skipped — fixture trees in tests stay clean.
+
+Findings anchor at the spec registration's file:line (the place to fix
+either side of the drift).
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable, Optional, Tuple
+
+from ..report import Finding
+
+RULE_NAME = "protocol-drift"
+DOC = __doc__
+
+#: enum pipe-lists live in EVENT_SCHEMAS field-description TEXT, either
+#: parenthesized ("what moved it (probe_ok | failures | ...)") or as the
+#: whole description ("start | promote | rollback")
+_PAREN_ENUM_RE = re.compile(r"\(([^()]*\|[^()]*)\)")
+
+
+def _declared_enum(event: str, field_name: str) -> Optional[Tuple[str, ...]]:
+    from ...utils.metrics import EVENT_SCHEMAS
+    desc = EVENT_SCHEMAS.get(event, {}).get("fields", {}).get(field_name)
+    if not isinstance(desc, str) or "|" not in desc:
+        return None
+    m = _PAREN_ENUM_RE.search(desc)
+    body = m.group(1) if m else desc
+    return tuple(sorted(tok.strip() for tok in body.split("|")))
+
+
+def check(ctx) -> Iterable[Finding]:
+    from ..protocol.spec import load_specs
+    from ...utils.metrics import EVENT_SCHEMAS
+
+    by_rel = {sf.rel: sf for sf in ctx.all_python()}
+    for spec in load_specs():
+        if spec.path not in by_rel:
+            continue   # fixture tree — the spec's own file isn't linted
+        present = [by_rel[m] for m in spec.modules if m in by_rel]
+        for mod in spec.modules:
+            if mod not in by_rel:
+                yield Finding(
+                    RULE_NAME, spec.path, spec.line,
+                    f"{spec.name}: declared module {mod!r} does not "
+                    "exist in the tree — the spec is orphaned from the "
+                    "implementation it models")
+        for literal, what in spec.literals.items():
+            if not any(literal in sf.text for sf in present):
+                yield Finding(
+                    RULE_NAME, spec.path, spec.line,
+                    f"{spec.name}: declared literal {literal!r} ({what}) "
+                    "appears in none of the modeled sources "
+                    f"{list(spec.modules)} — the implementation moved "
+                    "and the protocol spec did not")
+        for kind in spec.event_edges:
+            if kind not in EVENT_SCHEMAS:
+                yield Finding(
+                    RULE_NAME, spec.path, spec.line,
+                    f"{spec.name}: event_edges replays {kind!r} rows but "
+                    "that event is not declared in "
+                    "utils.metrics.EVENT_SCHEMAS")
+        for event, field_name, values in spec.enum_checks:
+            declared = _declared_enum(event, field_name)
+            if declared is None:
+                yield Finding(
+                    RULE_NAME, spec.path, spec.line,
+                    f"{spec.name}: enum_check on {event}.{field_name} "
+                    "but the EVENT_SCHEMAS field description carries no "
+                    "parseable '|' enum inventory")
+            elif set(declared) != set(values):
+                missing = sorted(set(values) - set(declared))
+                extra = sorted(set(declared) - set(values))
+                yield Finding(
+                    RULE_NAME, spec.path, spec.line,
+                    f"{spec.name}: {event}.{field_name} enum drift — "
+                    f"spec-only: {missing}, schema-only: {extra} "
+                    "(utils.metrics.EVENT_SCHEMAS is the declared "
+                    "inventory)")

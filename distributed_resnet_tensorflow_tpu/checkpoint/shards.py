@@ -34,6 +34,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..analysis.protocol.spec import Model, ProtocolSpec, register_spec
+
 SHARDS_DIR = "shards"
 SHARD_FORMAT = 1
 _DONE_PREFIX = ".done-"
@@ -358,3 +360,101 @@ class ShardReader:
         if not found and root not in ("batch_stats",):
             raise KeyError(f"no leaves under {root!r} in shard indexes")
         return out
+
+
+# ---------------------------------------------------------------------------
+# declared protocol model (analysis/protocol/, docs/static_analysis.md)
+# ---------------------------------------------------------------------------
+
+def _ckpt_commit_model(mutations):
+    """Crash-consistent sharded commit, 3 hosts, one step: every host
+    stages its shard then drops a ``.done-`` marker; the chief waits for
+    ALL markers before the manifest write + atomic staging->final rename
+    (checkpoint/manager.py ``_write_sharded``), or raises on the
+    finalize deadline — a torn step must never become visible.
+
+    State: ``(host_phases, markers, chief, committed, reader)`` —
+    ``host_phases[i]`` in idle/staged/marked/crashed, ``markers[i]``
+    whether host i's done marker is on disk, ``chief`` in wait/renamed/
+    aborted, ``reader`` what a poll-side consumer observed (None until
+    it opens the step; committed_steps only ever surfaces renamed
+    steps, so the reader action is gated on ``committed``).
+    """
+    n_hosts = 3
+
+    def actions(s):
+        ph, mk, chief, committed, reader = s
+        out = []
+        for i in range(n_hosts):
+            if ph[i] == "idle":
+                out.append((f"stage({i})",
+                            (ph[:i] + ("staged",) + ph[i + 1:],
+                             mk, chief, committed, reader)))
+            if ph[i] == "staged":
+                out.append((f"mark({i})",
+                            (ph[:i] + ("marked",) + ph[i + 1:],
+                             mk[:i] + (True,) + mk[i + 1:],
+                             chief, committed, reader)))
+            if i != 0 and ph[i] in ("idle", "staged"):
+                # a SIGKILL before the marker: the shard may be torn
+                out.append((f"crash({i})",
+                            (ph[:i] + ("crashed",) + ph[i + 1:],
+                             mk, chief, committed, reader)))
+        if chief == "wait" and ph[0] == "marked":
+            if all(mk) or "skip_marker_wait" in mutations:
+                out.append(("finalize_rename",
+                            (ph, mk, "renamed", True, reader)))
+            if not all(mk) and any(p == "crashed" for p in ph):
+                # finalize deadline expires -> manager RAISES; the step
+                # is abandoned in staging/, never renamed
+                out.append(("finalize_timeout",
+                            (ph, mk, "aborted", committed, reader)))
+        if committed and reader is None:
+            out.append(("reader_open",
+                        (ph, mk, chief, committed,
+                         f"step@{sum(mk)}/{n_hosts}")))
+        return out
+
+    def _committed_means_complete(s):
+        ph, mk, chief, committed, reader = s
+        return not committed or all(mk)
+
+    def _reader_never_torn(s):
+        reader = s[4]
+        return reader is None or reader == f"step@{n_hosts}/{n_hosts}"
+
+    return Model(
+        init=(("idle",) * n_hosts, (False,) * n_hosts,
+              "wait", False, None),
+        actions=actions,
+        invariants=(
+            ("committed_step_has_all_done_markers",
+             _committed_means_complete),
+            ("reader_never_observes_uncommitted_shards",
+             _reader_never_torn),
+        ),
+        liveness=(
+            ("chief_finalize_terminates", "eventually",
+             lambda s: s[2] != "wait"),
+            ("commit_can_succeed", "reachable",
+             lambda s: s[3]),
+        ),
+    )
+
+
+CKPT_COMMIT_PROTOCOL = register_spec(ProtocolSpec(
+    name="ckpt-sharded-commit",
+    title="crash-consistent sharded checkpoint commit: stage, per-host "
+          ".done- markers, chief finalize barrier, atomic rename",
+    modules=("distributed_resnet_tensorflow_tpu/checkpoint/shards.py",
+             "distributed_resnet_tensorflow_tpu/checkpoint/manager.py"),
+    bounds={"hosts": 3, "steps": 1},
+    model=_ckpt_commit_model,
+    mutations=("skip_marker_wait",),
+    event_edges={"ckpt_shard": {}},
+    literals={
+        "shards": "SHARDS_DIR — per-step shard payload directory",
+        ".done-": "_DONE_PREFIX — per-host staging-complete marker",
+        "host-": "per-host shard file stem",
+    },
+))

@@ -54,7 +54,7 @@ import time
 from typing import List, Optional
 
 from distributed_resnet_tensorflow_tpu.resilience.preemption import (
-    RESUMABLE_EXIT_CODE)
+    INTERRUPT_EXIT_CODE, RESUMABLE_EXIT_CODE)
 
 log = logging.getLogger(__name__)
 
@@ -320,7 +320,7 @@ def launch_local(num_processes: int, main_args: List[str],
         rc = _aggregate_rc([p.returncode for p in procs], forced)
     except KeyboardInterrupt:  # kill.sh parity (reference scripts/kill.sh)
         _signal_all(procs, signal.SIGTERM, skip_done=False)
-        rc = 130
+        rc = INTERRUPT_EXIT_CODE
     finally:
         if prev_term is not None:
             signal.signal(signal.SIGTERM, prev_term)

@@ -12,21 +12,27 @@ launcher exit-code contract.
 from .manifest import (  # noqa: F401
     committed_steps, manifest_status, write_manifest)
 from .preemption import (  # noqa: F401
-    FAILURE_EXIT_CODE, Preempted, PreemptionListener, RESUMABLE_EXIT_CODE)
+    FAILURE_EXIT_CODE, INTERRUPT_EXIT_CODE, Preempted, PreemptionListener,
+    RESUMABLE_EXIT_CODE)
 from .retry import retry_call  # noqa: F401
 
 #: The process exit-code contract (docs/resilience.md): the ONLY codes this
 #: framework deliberately exits with, and what each one tells the launcher
-#: (launch.py, scripts/submit_tpu_slurm.sh). Any ``sys.exit``/``os._exit``
-#: with an integer literal outside this registry is linter-rejected
-#: (analysis/rules/exit_codes.py: exit-code-contract) — new codes are a
-#: LAUNCHER PROTOCOL CHANGE and must be declared here + documented first.
+#: (launch.py, scripts/submit_tpu_slurm.sh). Any ``sys.exit``/``os._exit``/
+#: ``raise SystemExit`` with an integer literal outside this registry —
+#: including literals flowing out of functions whose return value feeds a
+#: ``sys.exit(...)`` — is linter-rejected (analysis/rules/exit_codes.py:
+#: exit-code-contract) — new codes are a LAUNCHER PROTOCOL CHANGE and must
+#: be declared here + documented first.
 EXIT_CONTRACT = {
     0: "success — run completed",
     RESUMABLE_EXIT_CODE: "resumable (EX_TEMPFAIL): checkpoint committed "
                          "(preemption / peer loss / hang teardown) — "
                          "requeue to resume",
     FAILURE_EXIT_CODE: "real failure — do not requeue",
+    INTERRUPT_EXIT_CODE: "operator ^C at the launcher (128+SIGINT) — "
+                         "deliberate stop: do not requeue, do not "
+                         "classify as a failure",
 }
 
 # sentinel (and faultinject) are NOT re-exported eagerly: sentinel imports

@@ -60,6 +60,7 @@ import time
 from typing import Callable, Optional, Set
 
 from .preemption import RESUMABLE_EXIT_CODE
+from ..analysis.protocol.spec import Model, ProtocolSpec, register_spec
 
 log = logging.getLogger(__name__)
 
@@ -627,3 +628,115 @@ class ElasticRuntime:
                 "step": int(step),
                 "coordinator": doc["coordinator"],
             })
+
+
+# ---------------------------------------------------------------------------
+# declared protocol model (analysis/protocol/, docs/static_analysis.md)
+# ---------------------------------------------------------------------------
+
+def _reshard_model(mutations):
+    """One reshard round, 3 hosts, exhaustive over every interleaving of
+    joins, crashes, settle expiry, the commit race and adoption.
+
+    State: ``(host_states, members, commit, settled, n_commits)`` —
+    ``host_states[i]`` in out/joined/done/aborted/dead, ``members`` the
+    sorted join-marker set, ``commit`` the committed member tuple from
+    commit.json (None before), ``settled`` whether the settle window has
+    elapsed since the last membership change, ``n_commits`` how many
+    times commit.json was created this round (capped at 2 — the safety
+    invariant fires at 2, counting higher only grows the state space).
+
+    Small-scope bounds baked in: the coordinator (host 0) never crashes —
+    losing it is the exit-75 requeue path, outside this round's protocol
+    — and exactly one round is played (rounds are independent by
+    construction: round-{gen} directories never collide).
+    """
+    n_hosts, min_hosts = 3, 2
+
+    def actions(s):
+        hs, mem, commit, settled, nc = s
+        mem_set = set(mem)
+        out = []
+        for i in range(n_hosts):
+            if hs[i] == "out":
+                h2 = hs[:i] + ("joined",) + hs[i + 1:]
+                out.append((f"join({i})",
+                            (h2, tuple(sorted(mem_set | {i})),
+                             commit, False, nc)))
+            if hs[i] == "joined":
+                if commit is not None:
+                    # adopt-commit-first rule: a joined host that finds
+                    # commit.json follows it — done if it is a member,
+                    # aborted ("committed without us" -> exit 75) if not
+                    to = "done" if i in commit else "aborted"
+                    h2 = hs[:i] + (to,) + hs[i + 1:]
+                    out.append((f"adopt({i})" if to == "done"
+                                else f"abort_foreign({i})",
+                                (h2, mem, commit, settled, nc)))
+                if i != 0:   # bound: the coordinator host never crashes
+                    h2 = hs[:i] + ("dead",) + hs[i + 1:]
+                    out.append((f"crash({i})",
+                                (h2, tuple(sorted(mem_set - {i})),
+                                 commit, False, nc)))
+        if commit is None and not settled and mem:
+            out.append(("settle_tick", (hs, mem, commit, True, nc)))
+        can_commit = (hs[0] == "joined" and 0 in mem_set
+                      and len(mem) >= min_hosts and settled)
+        if can_commit and (commit is None
+                           or "blind_commit_overwrite" in mutations):
+            # the exclusive os.link create makes the first writer win;
+            # the mutation models a plain open() overwrite instead
+            out.append(("commit_round",
+                        (hs, mem, mem, settled, min(nc + 1, 2))))
+        if commit is None and hs[0] == "joined" and len(mem) < min_hosts:
+            h2 = tuple("aborted" if h == "joined" else h for h in hs)
+            out.append(("abort_timeout", (h2, mem, commit, settled, nc)))
+        return out
+
+    def _single_commit(s):
+        return s[4] <= 1
+
+    def _done_only_committed(s):
+        hs, _, commit, _, _ = s
+        return all(h != "done" or (commit is not None and i in commit)
+                   for i, h in enumerate(hs))
+
+    return Model(
+        init=(("out",) * n_hosts, (), None, False, 0),
+        actions=actions,
+        invariants=(
+            ("at_most_one_commit_per_round", _single_commit),
+            ("done_only_inside_committed_membership",
+             _done_only_committed),
+        ),
+        liveness=(
+            ("every_joined_host_leaves_the_barrier", "eventually",
+             lambda s: "joined" not in s[0]),
+            ("settle_window_can_commit", "reachable",
+             lambda s: s[2] is not None),
+        ),
+    )
+
+
+RESHARD_PROTOCOL = register_spec(ProtocolSpec(
+    name="elastic-reshard-barrier",
+    title="elastic reshard barrier: join markers, settle window, "
+          "first-writer-wins commit.json, adopt-commit-first",
+    modules=("distributed_resnet_tensorflow_tpu/resilience/elastic.py",),
+    bounds={"hosts": 3, "min_hosts": 2, "rounds": 1, "settle_ticks": 1},
+    model=_reshard_model,
+    mutations=("blind_commit_overwrite",),
+    event_edges={
+        "reshard": {"reasons": ("peer_lost", "hang", "grow", "rejoin")},
+        "mesh_generation": {},
+    },
+    literals={
+        "commit.json": "the round's first-writer-wins commit marker",
+        "generation.json": "the adopted-generation record",
+        "round-": "per-round barrier directory prefix",
+        "join-": "per-worker join marker prefix",
+    },
+    enum_checks=(
+        ("reshard", "reason", ("peer_lost", "hang", "grow", "rejoin")),
+    ),
+))

@@ -33,6 +33,12 @@ RESUMABLE_EXIT_CODE = 75
 #: a real (non-resumable) failure — launchers must NOT requeue
 FAILURE_EXIT_CODE = 1
 
+#: shell convention 128+SIGINT — the operator hit ^C at the launcher.
+#: Deliberate, so NOT resumable (a requeue would resurrect the run the
+#: operator just killed) and not a failure either; schedulers leave it
+#: alone.
+INTERRUPT_EXIT_CODE = 130
+
 _DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
 

@@ -42,6 +42,8 @@ from typing import Dict, List, Optional
 from ..launch import terminate_child
 from ..utils.config import ExperimentConfig, resolve_checkpoint_dir
 from ..resilience.manifest import committed_steps
+from ..analysis.protocol.spec import Model, ProtocolSpec, register_spec
+from . import router
 
 log = logging.getLogger(__name__)
 
@@ -313,3 +315,151 @@ class FleetSupervisor:
             "gave_up": sorted(self._gave_up),
             "exit_codes": dict(self.rcs),
         }
+
+
+# ---------------------------------------------------------------------------
+# declared protocol model (analysis/protocol/, docs/static_analysis.md)
+# ---------------------------------------------------------------------------
+
+#: the replace ladder's event actions, in declared order (gave_up is the
+#: terminal off-ramp from any rung)
+REPLACE_LADDER = ("kill", "respawn", "readmit")
+
+
+def _health_replace_model(mutations):
+    """One replica: the router's ReplicaHealth machine interleaved with
+    this supervisor's replace ladder, at suspect_after=1 / dead_after=2
+    and a replace budget of 1.
+
+    State: ``(health, fails, sup, budget)`` — ``health`` a
+    serve/router.py health-state string, ``fails`` the consecutive-
+    failure counter (bounded by dead_after), ``sup`` the supervisor rung
+    (watch / pending_kill / pending_respawn / pending_readmit /
+    gave_up), ``budget`` replaces remaining. Health observations only
+    fire while the supervisor watches — mid-ladder the replica is
+    draining, where every ReplicaHealth input is a no-op by
+    construction (the model's second invariant pins that coupling).
+    """
+    suspect_after, dead_after = 1, 2
+
+    def actions(s):
+        health, fails, sup, budget = s
+        out = []
+        if sup == "watch":
+            if health == router.WARMING:
+                out.append(("probe_ok", (router.READY, 0, sup, budget)))
+            if health == router.SUSPECT:
+                out.append(("recover_ok", (router.READY, 0, sup, budget)))
+            if health in (router.READY, router.DEGRADED) and fails:
+                out.append(("ok", (health, 0, sup, budget)))
+            if health in (router.WARMING, router.READY,
+                          router.DEGRADED, router.SUSPECT):
+                # capped at the dead threshold: past it every further
+                # failure is behaviorally identical (keeps the mutated
+                # zombie_revive model finite too)
+                nf = min(fails + 1, dead_after)
+                if nf >= dead_after:
+                    out.append(("fail", (router.DEAD, nf, sup, budget)))
+                elif nf >= suspect_after and health != router.SUSPECT:
+                    out.append(("fail", (router.SUSPECT, nf, sup, budget)))
+                else:
+                    out.append(("fail", (health, nf, sup, budget)))
+            if health in (router.READY, router.DEGRADED, router.SUSPECT):
+                out.append(("beat_stale", (router.DEAD, fails,
+                                           sup, budget)))
+            if health == router.READY:
+                out.append(("slo_pressure", (router.DEGRADED, fails,
+                                             sup, budget)))
+            if health == router.DEGRADED:
+                out.append(("slo_recovered", (router.READY, fails,
+                                              sup, budget)))
+            if health == router.DEAD:
+                if budget > 0:
+                    # condemn: mark_draining precedes the kill row
+                    out.append(("condemn", (router.DRAINING, fails,
+                                            "pending_kill", budget - 1)))
+                else:
+                    out.append(("budget_exhausted",
+                                (health, fails, "gave_up", budget)))
+                if "illegal_health_edge" in mutations:
+                    # the bug class HEALTH_EDGES exists to exclude: a
+                    # dead replica re-entering rotation without the
+                    # drain -> respawn -> warm -> readmit ladder
+                    out.append(("zombie_revive",
+                                (router.READY, fails, sup, budget)))
+        elif sup == "pending_kill":
+            out.append(("kill", (health, fails, "pending_respawn",
+                                 budget)))
+        elif sup == "pending_respawn":
+            out.append(("respawn", (health, fails, "pending_readmit",
+                                    budget)))
+        elif sup == "pending_readmit":
+            out.append(("readmit", (router.WARMING, 0, "watch", budget)))
+            out.append(("warm_timeout", (health, fails, "gave_up",
+                                         budget)))
+        return out
+
+    def _dispatchable_below_dead(s):
+        health, fails = s[0], s[1]
+        return health not in router.DISPATCHABLE or fails < dead_after
+
+    def _ladder_implies_draining(s):
+        health, sup = s[0], s[2]
+        return (sup not in ("pending_kill", "pending_respawn",
+                            "pending_readmit")
+                or health == router.DRAINING)
+
+    return Model(
+        init=(router.WARMING, 0, "watch", 1),
+        actions=actions,
+        invariants=(
+            ("dead_to_ready_only_via_replace_ladder",
+             _dispatchable_below_dead),
+            ("mid_ladder_replica_is_draining", _ladder_implies_draining),
+        ),
+        liveness=(
+            ("killed_replica_round_terminates", "eventually",
+             lambda s: s[2] == "gave_up" or s[0] == router.READY),
+            ("full_ladder_returns_to_service", "reachable",
+             lambda s: s[2] == "watch" and s[0] == router.READY
+             and s[3] == 0),
+        ),
+    )
+
+
+HEALTH_REPLACE_PROTOCOL = register_spec(ProtocolSpec(
+    name="replica-health-replace",
+    title="router replica-health machine x fleet watchdog replace "
+          "ladder: condemn -> drain -> kill -> respawn -> readmit",
+    modules=("distributed_resnet_tensorflow_tpu/serve/router.py",
+             "distributed_resnet_tensorflow_tpu/serve/fleet.py"),
+    bounds={"replicas": 1, "suspect_after": 1, "dead_after": 2,
+            "max_replaces": 1},
+    model=_health_replace_model,
+    mutations=("illegal_health_edge",),
+    event_edges={
+        "replica_health": {"edges": router.HEALTH_EDGES,
+                           "initial": router.WARMING},
+        "replica_replace": {"actions": REPLACE_LADDER + ("gave_up",),
+                            "reasons": ("exited", "wedged", "dead"),
+                            "ladder": REPLACE_LADDER},
+    },
+    literals={
+        router.WARMING: "health state", router.READY: "health state",
+        router.DEGRADED: "health state", router.SUSPECT: "health state",
+        router.DRAINING: "health state", router.DEAD: "health state",
+        "kill": "replace-ladder action", "respawn": "replace-ladder "
+        "action", "readmit": "replace-ladder action",
+        "gave_up": "replace-budget off-ramp action",
+    },
+    enum_checks=(
+        ("replica_health", "from",
+         (router.WARMING, router.READY, router.DEGRADED, router.SUSPECT,
+          router.DRAINING, router.DEAD)),
+        ("replica_health", "reason",
+         ("probe_ok", "failures", "beat_stale", "slo_pressure",
+          "recovered", "drain", "readmit")),
+        ("replica_replace", "action", REPLACE_LADDER + ("gave_up",)),
+        ("replica_replace", "reason", ("exited", "wedged", "dead")),
+    ),
+))

@@ -185,6 +185,46 @@ class ReplicaHealth:
         return self.state in DISPATCHABLE
 
 
+#: the COMPLETE set of (from, to, reason) edges :class:`ReplicaHealth`
+#: can emit — the declared side of the health state machine. The
+#: protocol spec (serve/fleet.py, analysis/protocol/) models and
+#: trace-checks against this table, so keep it in lockstep with the
+#: transition methods above: an edge the code grows without a row here
+#: shows up as an undeclared-edge finding on the next chaos smoke.
+HEALTH_EDGES = (
+    # on_success
+    (WARMING, READY, "probe_ok"),
+    (SUSPECT, READY, "recovered"),
+    # on_failure threshold ladder (dead_after >= suspect_after, so a
+    # warming replica can fall straight to dead when they are equal)
+    (WARMING, SUSPECT, "failures"),
+    (READY, SUSPECT, "failures"),
+    (DEGRADED, SUSPECT, "failures"),
+    (WARMING, DEAD, "failures"),
+    (READY, DEAD, "failures"),
+    (DEGRADED, DEAD, "failures"),
+    (SUSPECT, DEAD, "failures"),
+    # on_beat (warming/dead/draining exempt)
+    (READY, DEAD, "beat_stale"),
+    (DEGRADED, DEAD, "beat_stale"),
+    (SUSPECT, DEAD, "beat_stale"),
+    # on_pressure
+    (READY, DEGRADED, "slo_pressure"),
+    (DEGRADED, READY, "recovered"),
+    # drain / readmit (supervisor-driven; _move drops self-loops)
+    (WARMING, DRAINING, "drain"),
+    (READY, DRAINING, "drain"),
+    (DEGRADED, DRAINING, "drain"),
+    (SUSPECT, DRAINING, "drain"),
+    (DEAD, DRAINING, "drain"),
+    (READY, WARMING, "readmit"),
+    (DEGRADED, WARMING, "readmit"),
+    (SUSPECT, WARMING, "readmit"),
+    (DRAINING, WARMING, "readmit"),
+    (DEAD, WARMING, "readmit"),
+)
+
+
 def pick_replica(health: Dict[int, ReplicaHealth],
                  outstanding: Dict[int, int],
                  exclude: Sequence[int] = ()) -> Optional[int]:

@@ -33,6 +33,7 @@ import numpy as np
 from ..resilience.manifest import (committed_steps, manifest_digest,
                                    manifest_status)
 from ..telemetry.tracer import span
+from ..analysis.protocol.spec import Model, ProtocolSpec, register_spec
 
 log = logging.getLogger(__name__)
 
@@ -264,3 +265,105 @@ class CheckpointSwapper:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# declared protocol model (analysis/protocol/, docs/static_analysis.md)
+# ---------------------------------------------------------------------------
+
+def _canary_pin_model(mutations):
+    """The SWAP_CONTROL.json pin protocol: a 2-replica fleet (one canary
+    arm, one control arm) racing one committed checkpoint through the
+    canary ladder, each replica's swapper polling its own pin file.
+
+    State: ``(ctrl, pin0, pin1, app0, app1, leaked)`` — ``ctrl`` the
+    CanaryController phase (idle / active / promoted / rolled_back),
+    ``pinN``/``appN`` the pinned and applied step ("old"/"new") of
+    replica 0 (canary arm) and 1 (control arm), ``leaked`` whether any
+    swapper ever applied a step its pin did not name (the gating bug
+    class ``_poll_gated`` exists to prevent).
+    """
+    def actions(s):
+        ctrl, pin0, pin1, app0, app1, leaked = s
+        out = []
+        if ctrl == "idle":
+            # a committed step appears: canary arm pinned forward, the
+            # control arm re-pinned to the incumbent
+            out.append(("commit_new",
+                        ("active", "new", "old", app0, app1, leaked)))
+        pins, apps = (pin0, pin1), (app0, app1)
+        for i in range(2):
+            if "apply_unpinned" in mutations:
+                # an ungated swapper chases the newest commit directly
+                if ctrl != "idle" and apps[i] != "new":
+                    a2 = ["new" if j == i else apps[j] for j in range(2)]
+                    out.append((f"swap_poll({i})",
+                                (ctrl, pin0, pin1, a2[0], a2[1],
+                                 leaked or pins[i] != "new")))
+            elif apps[i] != pins[i]:
+                a2 = [pins[j] if j == i else apps[j] for j in range(2)]
+                out.append((f"swap_poll({i})",
+                            (ctrl, pin0, pin1, a2[0], a2[1], leaked)))
+        if ctrl == "active":
+            if app0 == "new":
+                # canary confirmed + verdict clean: fleet-wide re-pin
+                out.append(("promote",
+                            ("promoted", "new", "new", app0, app1,
+                             leaked)))
+            out.append(("rollback",
+                        ("rolled_back", "old", pin1, app0, app1,
+                         leaked)))
+        return out
+
+    return Model(
+        init=("idle", "old", "old", "old", "old", False),
+        actions=actions,
+        invariants=(
+            ("pinned_replica_never_applies_unpinned_commit",
+             lambda s: not s[5]),
+            ("control_arm_stays_on_incumbent_while_canary_active",
+             lambda s: s[0] != "active" or s[4] == "old"),
+        ),
+        liveness=(
+            ("canary_verdict_reached", "eventually",
+             lambda s: s[0] != "active"),
+            ("promote_can_converge_fleet_wide", "reachable",
+             lambda s: s[0] == "promoted" and s[3] == "new"
+             and s[4] == "new"),
+        ),
+    )
+
+
+CANARY_PIN_PROTOCOL = register_spec(ProtocolSpec(
+    name="canary-swap-pin",
+    title="canary swap-control pin: SWAP_CONTROL.json per-replica pins, "
+          "gated swapper, promote/rollback re-pin",
+    modules=("distributed_resnet_tensorflow_tpu/serve/swap.py",
+             "distributed_resnet_tensorflow_tpu/serve/fleet.py",
+             "distributed_resnet_tensorflow_tpu/serve/router.py"),
+    bounds={"replicas": 2, "commits": 1},
+    model=_canary_pin_model,
+    mutations=("apply_unpinned",),
+    event_edges={
+        "canary": {
+            "actions": ("start", "promote", "rollback"),
+            "reasons_by_action": {
+                "promote": ("promoted", "single_replica"),
+                "rollback": ("p99_regression", "confidence_regression",
+                             "no_confirm"),
+            },
+        },
+    },
+    literals={
+        "SWAP_CONTROL.json": "the per-replica pin file",
+        "target_step": "the pin file's single field",
+        "start": "canary row action", "promote": "canary row action",
+        "rollback": "canary row action",
+    },
+    enum_checks=(
+        ("canary", "action", ("start", "promote", "rollback")),
+        ("canary", "reason",
+         ("p99_regression", "confidence_regression", "no_confirm",
+          "promoted", "single_replica")),
+    ),
+))

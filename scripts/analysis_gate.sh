@@ -12,11 +12,12 @@
 #
 #   scripts/analysis_gate.sh                 # full gate (lint + elaborate
 #                                            #   + zero1 sweep + hangcheck
-#                                            #   + plan-drift)
+#                                            #   + plan-drift + protocol)
 #   scripts/analysis_gate.sh --lint-only     # sub-second syntax/invariant pass
 #   scripts/analysis_gate.sh --no-hangcheck  # skip the hangcheck phases
 #                                            #   (mirrors --no-zero1-sweep,
-#                                            #   --no-plan-drift)
+#                                            #   --no-plan-drift,
+#                                            #   --no-protocol)
 #
 # Wired as a pre-submit step in scripts/submit_tpu_slurm.sh and into the
 # pre-merge chaos gate (scripts/chaos_smoke.sh --fast). Exit 0 = clean,
@@ -24,10 +25,13 @@
 #
 # Budget contract (docs/static_analysis.md): the FULL gate finishes in
 # <300 s — per-phase wall times are printed by the check CLI (lint /
-# elaborate / elab-zero1 / hangcheck-schedule / plan-drift lines — the
-# plan-drift phase (ISSUE 17, docs/planner.md) re-costs the what-if
-# planner over the committed schedules and refreshes
-# analysis/plan_catalog.json; measured ~3-6 s, well inside the same
+# elaborate / elab-zero1 / hangcheck-schedule / plan-drift / protocol
+# lines — the plan-drift phase (ISSUE 17, docs/planner.md) re-costs the
+# what-if planner over the committed schedules and refreshes
+# analysis/plan_catalog.json; measured ~3-6 s; the protocol phase
+# (ISSUE 20) exhaustively model-checks the four declared control-plane
+# protocols and refreshes analysis/protocol_models.json; measured
+# <0.5 s — both well inside the same
 # 300 s envelope), and this script
 # fails loudly when the total busts the budget, so creep shows up as a
 # red gate in the PR that caused it, not as a slow submit host months

@@ -133,7 +133,18 @@ assert shrink["restore_step"] >= 0, "shrink restarted instead of resuming"
 print("elastic smoke: shrink restored step", shrink["restore_step"],
       "-> grow live at generation", grow["generation"])
 PY
-  echo "chaos_smoke: elastic shrink->grow verified (rc=0, no exit-75)"
+  # protocol trace conformance (analysis/protocol/): the reshard /
+  # mesh_generation rows this chaos run recorded must replay cleanly
+  # against the declared elastic-reshard-barrier spec, and the seeded
+  # illegal-edge self-test proves the witness can actually fail
+  env JAX_PLATFORMS=cpu python -m \
+    distributed_resnet_tensorflow_tpu.analysis.protocol.conformance \
+    "$TROOT/train/metrics.jsonl"
+  env JAX_PLATFORMS=cpu python -m \
+    distributed_resnet_tensorflow_tpu.analysis.protocol.conformance \
+    --self-test-illegal-edge "$TROOT/train/metrics.jsonl"
+  echo "chaos_smoke: elastic shrink->grow verified (rc=0, no exit-75," \
+       "protocol trace conformant)"
   exit 0
 fi
 

@@ -150,3 +150,17 @@ print("fleet smoke OK:", json.dumps({
     "canary_rollback_reason": rollbacks[-1].get("reason"),
     "fleet_step": router["fleet_step"]}))
 EOF
+
+# 6) protocol trace conformance (analysis/protocol/, docs/
+# static_analysis.md): every recorded replica_health / replica_replace /
+# canary row must be an edge the DECLARED state machines allow — the
+# chaos run above doubles as a protocol-conformance witness. Then the
+# witness-can-fail leg: a seeded dead->ready health edge must be caught
+# (exit 0 = caught), so a silently-vacuous replayer fails the smoke.
+env JAX_PLATFORMS=cpu python -m \
+  distributed_resnet_tensorflow_tpu.analysis.protocol.conformance \
+  "$ROOT/route/metrics.jsonl" "$ROOT"/serve-r*/metrics.jsonl
+env JAX_PLATFORMS=cpu python -m \
+  distributed_resnet_tensorflow_tpu.analysis.protocol.conformance \
+  --self-test-illegal-edge "$ROOT/route/metrics.jsonl"
+echo "fleet smoke: protocol trace conformance OK (incl. seeded-edge self-test)"

@@ -67,6 +67,23 @@ def record(span):
 
 def stall_the_loop(f):
     os.fsync(f.fileno())                            # line 43: ckpt-io-thread
+
+
+def depart():
+    rc = 7                                          # line 47: exit-flow literal
+    return rc
+
+
+def relay():
+    return depart()
+
+
+def gone():
+    sys.exit(relay())
+
+
+def slam():
+    raise SystemExit(9)                             # line 60: SystemExit literal
 '''
 
 BAD_SH = '''\
@@ -113,8 +130,12 @@ def test_each_rule_fires_with_file_and_line(bad_repo):
     assert (bad_py, 34) in cached            # direct-wrap form
     f = by_rule["bare-assert"][0]
     assert (f.path, f.line) == (bad_py, 18)
-    f = by_rule["exit-code-contract"][0]
-    assert (f.path, f.line) == (bad_py, 23)
+    exits = {(f.path, f.line) for f in by_rule["exit-code-contract"]}
+    assert (bad_py, 23) in exits         # direct sys.exit literal
+    assert (bad_py, 47) in exits         # literal flowing out of depart()
+    #                                      through relay() into sys.exit
+    assert (bad_py, 60) in exits         # raise SystemExit(<literal>)
+    assert exits == {(bad_py, 23), (bad_py, 47), (bad_py, 60)}, exits
     drift = {(f.path, f.line) for f in by_rule["registry-drift"]}
     assert (bad_py, 27) in drift                       # undeclared event
     assert (bad_py, 38) in drift                       # undeclared span
@@ -266,8 +287,11 @@ def test_config_knob_resolution():
 
 def test_exit_contract_registry():
     from distributed_resnet_tensorflow_tpu.resilience import (
-        EXIT_CONTRACT, FAILURE_EXIT_CODE, RESUMABLE_EXIT_CODE)
-    assert set(EXIT_CONTRACT) == {0, FAILURE_EXIT_CODE, RESUMABLE_EXIT_CODE}
+        EXIT_CONTRACT, FAILURE_EXIT_CODE, INTERRUPT_EXIT_CODE,
+        RESUMABLE_EXIT_CODE)
+    assert set(EXIT_CONTRACT) == {0, FAILURE_EXIT_CODE,
+                                  RESUMABLE_EXIT_CODE, INTERRUPT_EXIT_CODE}
+    assert INTERRUPT_EXIT_CODE == 130    # shell convention: 128 + SIGINT
 
 
 # ---------------------------------------------------------------------------

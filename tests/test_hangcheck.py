@@ -91,6 +91,15 @@ def test_real_tree_spawn_sites_all_registered():
     unregistered = [s.target.short() for s in spawns
                     if threads.role_of(s.target) is None]
     assert unregistered == [], unregistered
+    # the fleet front door's thread inventory (ISSUE 20 satellite): the
+    # listener's accept/connection threads, the router's pool loops, and
+    # the supervisor watch must all be spawned through resolvable,
+    # registered targets — these are the roots the socket sweep walks
+    shorts = {s.target.short() for s in spawns}
+    assert {"serve/wire.py::ReplicaListener._accept_loop",
+            "serve/wire.py::ReplicaListener._handle_conn",
+            "serve/router.py::Router._health_loop",
+            "serve/fleet.py::FleetSupervisor._watch"} <= shorts, shorts
 
 
 # ---------------------------------------------------------------------------
@@ -130,6 +139,51 @@ def test_untimed_blocking_call_fixture(tmp_path):
     assert (rel, 5) in hits
     assert (rel, 14) in hits
     assert hits == {(rel, 5), (rel, 14)}, hits
+
+
+BAD_SOCK = '''\
+import threading
+
+
+class Listener:
+    def loop(self):
+        self.sock.settimeout(None)           # DISARMS: not a blessing
+        while True:
+            conn, _ = self.sock.accept()     # line 8: untimed accept
+            data = conn.recv(4096)           # line 9: untimed recv
+            self.handle(data)
+
+    def handle(self, data):
+        return data
+
+    def start(self):
+        t = threading.Thread(target=self.loop)
+        t.start()
+'''
+
+
+def test_socket_wait_sweep_fixture(tmp_path):
+    """Socket waits on a spawned thread with no armed settimeout are
+    findings; arming the deadline in the lifecycle method before the
+    spawn (the serve/wire.py listener idiom) blesses the root."""
+    pkg = tmp_path / PKG / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "bad_sock.py").write_text(BAD_SOCK)
+    rel = os.path.join(PKG, "serve", "bad_sock.py")
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    hits = {(f.path, f.line) for f in by_rule["untimed-blocking-call"]
+            if "socket" in f.message}
+    assert hits == {(rel, 8), (rel, 9)}, hits
+
+    (pkg / "bad_sock.py").write_text(BAD_SOCK.replace(
+        "        t = threading.Thread(target=self.loop)",
+        "        self.sock.settimeout(0.5)\n"
+        "        t = threading.Thread(target=self.loop)"))
+    by_rule = _by_rule(run_lint(str(tmp_path)))
+    hits = {(f.path, f.line) for f in
+            by_rule.get("untimed-blocking-call", ())
+            if "socket" in f.message}
+    assert hits == set(), hits
 
 
 # ---------------------------------------------------------------------------

@@ -63,7 +63,12 @@ def _train(mesh_cfg, batches, **kw):
 
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(data=8),                    # dp
-    MeshConfig(data=4, fsdp=2),            # dp_fsdp (gather + reduce-scatter)
+    # dp_fsdp re-tiered out of the 870s tier-1 (ISSUE 20, ~10s: two full
+    # trainings on the sharded layout); the dp leg keeps the bucketing
+    # bit-identity claim in tier-1 and the dp_fsdp LAYOUT stays covered
+    # by test_zero1_overlap_matches_plain_path[dp_fsdp]; the full
+    # (unfiltered) suite runs both
+    pytest.param(MeshConfig(data=4, fsdp=2), marks=pytest.mark.slow),
 ], ids=["dp", "dp_fsdp"])
 def test_bucketed_is_bit_identical_to_unbucketed(mesh_cfg):
     """Many tiny buckets vs one bucket holding everything: the per-leaf
@@ -262,6 +267,12 @@ def test_vit_overlap_legs_match_default_path(mesh_cfg, experts,
     assert abs(float(mo["loss"]) - float(mb["loss"])) < 5e-4
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (ISSUE 20, ~13s:
+# two 4-step MoE-pipeline trainings); tier-1 keeps the same bit-identity
+# claim via test_bucketed_is_bit_identical_to_unbucketed[dp] and the
+# same dp_pp_ep-family layout through the overlap path via
+# test_vit_overlap_legs_match_default_path[dp_pp]; the full (unfiltered)
+# suite runs this grouped-bucket composition
 def test_vit_overlap_bucketing_bit_identical_dp_pp_ep(devices):
     """Many-vs-one-bucket on the MoE pipeline layout: grouped buckets
     (one reduce-axis set each) are still a pure scheduling change."""

@@ -590,6 +590,11 @@ def test_pipelined_vit_ring_through_trainer():
 
 
 @pytest.mark.heavy
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (ISSUE 20, ~12s: the
+# joint pp x sp x ep composition trains twice); tier-1 keeps each leg of
+# the composition via test_pipelined_moe_matches_sequential (pp x ep) and
+# test_ring_flash_matches_lax_ring (sp ring attention); the full
+# (unfiltered) suite still runs the joint model
 def test_pipeline_ring_moe_matches_sequential():
     """pp x sp x ep — the joint composition the round-4 review called out
     as uncovered ("the 6-axis mesh still cannot jointly cover a

@@ -210,6 +210,11 @@ def test_bf16_step_allclose_remaining_matrix_dp(opt, zero1):
 # compressed gradient exchange
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (ISSUE 20, ~11s: two
+# full trainings under compress+overlap on dp_fsdp); tier-1 keeps the
+# compressed-wire contract via test_precision_and_compress_event_rows and
+# the bf16 numerics via the f32-oracle allclose tests; the full
+# (unfiltered) suite still runs this bucketing composition
 def test_compressed_exchange_bucketing_is_bit_identical(devices):
     """The compression cast is per-leaf and commutes with bucketing:
     many tiny buckets vs one giant bucket under comm.compress=bf16 must

@@ -1,0 +1,315 @@
+"""Protocol model checker tests (ISSUE 20 tentpole): every declared spec
+checks clean exhaustively, the committed artifact is byte-identical to a
+fresh run, every seeded mutation produces a counterexample action
+schedule anchored at the spec registration's file:line, the runtime
+trace replayer accepts legal rows and flags each class of illegal row at
+its line, and the protocol-drift lint rule catches spec/implementation
+divergence."""
+import json
+import os
+
+import pytest
+
+from distributed_resnet_tensorflow_tpu.analysis.protocol import (
+    artifact_path, check_model, check_rows, check_stream, load_specs,
+    run_protocol, write_artifact)
+
+PKG = "distributed_resnet_tensorflow_tpu"
+
+#: spec name -> (seeded mutation, violated invariant, action that must
+#: appear in the counterexample schedule)
+MUTATION_LEGS = {
+    "elastic-reshard-barrier": (
+        "blind_commit_overwrite", "at_most_one_commit_per_round",
+        "commit_round"),
+    "ckpt-sharded-commit": (
+        "skip_marker_wait", "committed_step_has_all_done_markers",
+        "finalize_rename"),
+    "replica-health-replace": (
+        "illegal_health_edge", "dead_to_ready_only_via_replace_ladder",
+        "zombie_revive"),
+    "canary-swap-pin": (
+        "apply_unpinned", "pinned_replica_never_applies_unpinned_commit",
+        "swap_poll"),
+}
+
+
+def _specs_by_name():
+    return {spec.name: spec for spec in load_specs()}
+
+
+# ---------------------------------------------------------------------------
+# exhaustive check: clean models, determinism, artifact byte-identity
+# ---------------------------------------------------------------------------
+
+def test_all_declared_specs_check_clean():
+    specs = _specs_by_name()
+    assert set(specs) == set(MUTATION_LEGS)
+    for spec in specs.values():
+        findings, stats = check_model(spec)
+        assert findings == [], [str(f) for f in findings]
+        assert stats["states"] > 1 and stats["transitions"] > 0
+        assert not stats["truncated"]
+        assert stats["fingerprint"].startswith("sha256:")
+        # the ISSUE 20 contract: >=1 safety and >=1 liveness per protocol
+        assert spec.safety_names(), spec.name
+        assert spec.liveness_names(), spec.name
+
+
+def test_run_protocol_is_deterministic():
+    f1, doc1 = run_protocol()
+    f2, doc2 = run_protocol()
+    assert f1 == [] and f2 == []
+    assert doc1 == doc2
+    assert doc1["schema_version"] == 1
+    assert set(doc1["specs"]) == set(MUTATION_LEGS)
+
+
+def test_committed_artifact_matches_fresh_run(tmp_path):
+    """analysis/protocol_models.json is the gate-refreshed inventory —
+    a fresh exhaustive run must reproduce it byte-for-byte."""
+    _, doc = run_protocol()
+    fresh = str(tmp_path / "fresh.json")
+    write_artifact(doc, fresh)
+    assert open(fresh, "rb").read() == open(artifact_path(), "rb").read()
+    committed = json.load(open(artifact_path()))
+    for name, entry in committed["specs"].items():
+        assert entry["declared_at"].count(":") == 1
+        rel, line = entry["declared_at"].split(":")
+        assert os.path.exists(os.path.join(
+            os.path.dirname(artifact_path()), "..", "..", rel)), rel
+        assert int(line) > 0
+
+
+# ---------------------------------------------------------------------------
+# seeded mutations: the checker catches the bug class each guard prevents
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(MUTATION_LEGS))
+def test_seeded_mutation_yields_counterexample_at_spec_site(name):
+    spec = _specs_by_name()[name]
+    mutation, invariant, schedule_action = MUTATION_LEGS[name]
+    findings, stats = check_model(spec, frozenset({mutation}))
+    hits = [f for f in findings if invariant in f.message]
+    assert hits, [str(f) for f in findings]
+    f = hits[0]
+    # anchored at the registration site in the implementation module
+    assert (f.path, f.line) == (spec.path, spec.line)
+    assert f.path.endswith(".py") and f.path.startswith(PKG)
+    # the counterexample is a concrete action schedule featuring the
+    # weakened guard's action
+    assert schedule_action in f.message
+    assert "schedule:" in f.detail and "final state:" in f.detail
+
+
+def test_unknown_mutation_is_rejected():
+    spec = _specs_by_name()["elastic-reshard-barrier"]
+    with pytest.raises(ValueError, match="unknown mutation"):
+        check_model(spec, frozenset({"not_a_mutation"}))
+
+
+# ---------------------------------------------------------------------------
+# trace conformance: legal rows replay clean, each illegal class flagged
+# ---------------------------------------------------------------------------
+
+def _h(line, frm, to, reason, replica=0):
+    return (line, {"event": "replica_health", "replica": replica,
+                   "from": frm, "to": to, "reason": reason})
+
+
+def test_conformance_accepts_legal_health_and_ladder_rows():
+    rows = [
+        _h(1, "warming", "ready", "probe_ok"),
+        _h(2, "ready", "suspect", "failures"),
+        _h(3, "suspect", "ready", "recovered"),
+        _h(4, "ready", "dead", "beat_stale"),
+        (5, {"event": "replica_replace", "action": "kill",
+             "replica": 0, "reason": "wedged"}),
+        (6, {"event": "replica_replace", "action": "respawn",
+             "replica": 0}),
+        (7, {"event": "replica_replace", "action": "readmit",
+             "replica": 0}),
+        _h(8, "dead", "warming", "readmit"),
+        _h(9, "warming", "ready", "probe_ok"),
+    ]
+    assert check_rows(rows) == []
+
+
+def test_conformance_flags_illegal_health_edge_and_chain_break():
+    findings = check_rows([_h(3, "dead", "ready", "probe_ok")])
+    assert [f.line for f in findings] == [3]
+    assert "undeclared replica_health edge" in findings[0].message
+    # chain break: the row leaves a state the replica never landed in
+    findings = check_rows([
+        _h(1, "warming", "ready", "probe_ok"),
+        _h(2, "suspect", "dead", "failures"),
+    ])
+    assert [f.line for f in findings] == [2]
+    assert "chain break" in findings[0].message
+
+
+def test_conformance_flags_ladder_violations():
+    # respawn with no preceding kill
+    findings = check_rows([(4, {"event": "replica_replace",
+                                "action": "respawn", "replica": 1})])
+    assert [f.line for f in findings] == [4]
+    assert "ladder violation" in findings[0].message
+    # anything after gave_up (the ladder is terminal)
+    findings = check_rows([
+        (1, {"event": "replica_replace", "action": "gave_up",
+             "replica": 1, "reason": "dead"}),
+        (2, {"event": "replica_replace", "action": "kill",
+             "replica": 1, "reason": "dead"}),
+    ])
+    assert [f.line for f in findings] == [2]
+    assert "after gave_up" in findings[0].message
+
+
+def test_conformance_flags_canary_discipline():
+    # rollback without a start
+    findings = check_rows([(7, {"event": "canary", "action": "rollback",
+                                "step": 100,
+                                "reason": "p99_regression"})])
+    assert [f.line for f in findings] == [7]
+    assert "without a preceding start" in findings[0].message
+    # the single-replica promote is the one declared exemption
+    assert check_rows([(1, {"event": "canary", "action": "promote",
+                            "step": 100,
+                            "reason": "single_replica"})]) == []
+    # two concurrent canaries
+    findings = check_rows([
+        (1, {"event": "canary", "action": "start", "step": 100}),
+        (2, {"event": "canary", "action": "start", "step": 200}),
+    ])
+    assert [f.line for f in findings] == [2]
+    assert "one canary at a time" in findings[0].message
+
+
+def test_conformance_flags_generation_and_commit_monotonicity():
+    findings = check_rows([
+        (1, {"event": "mesh_generation", "generation": 2}),
+        (2, {"event": "mesh_generation", "generation": 1}),
+    ])
+    assert [f.line for f in findings] == [2]
+    assert "only ever advance" in findings[0].message
+    findings = check_rows([(3, {"event": "reshard", "reason": "peer_lost",
+                                "old_hosts": 2, "new_hosts": 2,
+                                "generation": 1})])
+    assert [f.line for f in findings] == [3]
+    assert "must shrink" in findings[0].message
+    findings = check_rows([
+        (1, {"event": "ckpt_shard", "process": 0,
+             "last_committed_step": 50}),
+        (2, {"event": "ckpt_shard", "process": 0,
+             "last_committed_step": 40}),
+    ])
+    assert [f.line for f in findings] == [2]
+    assert "never un-commits" in findings[0].message
+
+
+def test_conformance_stream_spans_rotation_and_skips_torn_lines(tmp_path):
+    """A protocol round split across a rotation replays whole (the .1
+    segment is prepended), and a torn mid-write line is skipped the way
+    the monitor skips it."""
+    stream = tmp_path / "metrics.jsonl"
+    rot = tmp_path / "metrics.jsonl.1"
+    rot.write_text(
+        json.dumps({"event": "replica_health", "replica": 0,
+                    "from": "warming", "to": "ready",
+                    "reason": "probe_ok"}) + "\n"
+        + json.dumps({"event": "canary", "action": "start",
+                      "step": 100}) + "\n")
+    stream.write_text(
+        json.dumps({"event": "canary", "action": "promote", "step": 100,
+                    "reason": "promoted"}) + "\n"
+        + '{"event": "replica_health", "replica": 0, "fr')  # torn tail
+    assert check_stream(str(stream)) == []
+    # WITHOUT the rotated segment the promote has no start -> finding
+    rot.unlink()
+    findings = check_stream(str(stream))
+    assert findings and "without a preceding start" in findings[0].message
+
+
+def test_conformance_cli_self_test_catches_seeded_edge(tmp_path, capsys):
+    from distributed_resnet_tensorflow_tpu.analysis.protocol import (
+        conformance)
+    stream = tmp_path / "metrics.jsonl"
+    stream.write_text(json.dumps(
+        {"event": "replica_health", "replica": 0, "from": "warming",
+         "to": "ready", "reason": "probe_ok"}) + "\n")
+    assert conformance.main([str(stream)]) == 0
+    assert conformance.main(["--self-test-illegal-edge",
+                             str(stream)]) == 0
+    assert "seeded illegal edge caught" in capsys.readouterr().out
+    # a genuinely dirty stream exits nonzero with file:line
+    stream.write_text(json.dumps(
+        {"event": "replica_health", "replica": 0, "from": "dead",
+         "to": "ready", "reason": "probe_ok"}) + "\n")
+    assert conformance.main([str(stream)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# protocol-drift lint rule
+# ---------------------------------------------------------------------------
+
+def test_protocol_drift_clean_on_real_tree():
+    from distributed_resnet_tensorflow_tpu.analysis.lint import (
+        build_context)
+    from distributed_resnet_tensorflow_tpu.analysis.rules import (
+        protocol_drift)
+    findings = list(protocol_drift.check(build_context()))
+    assert findings == [], [str(f) for f in findings]
+
+
+def _drifted_spec(path):
+    from distributed_resnet_tensorflow_tpu.analysis.protocol.spec import (
+        ProtocolSpec)
+    return ProtocolSpec(
+        name="drifted", title="seeded drift", path=path, line=7,
+        modules=(path, os.path.join(PKG, "serve", "gone.py")),
+        bounds={}, model=lambda m: None,
+        literals={"no_such_literal_anywhere_9f3": "renamed away"},
+        event_edges={"not_an_event": {}},
+        enum_checks=(("canary", "action", ("start", "promote")),))
+
+
+def test_protocol_drift_fires_on_seeded_divergence(monkeypatch):
+    from distributed_resnet_tensorflow_tpu.analysis.lint import (
+        build_context)
+    from distributed_resnet_tensorflow_tpu.analysis.protocol import spec \
+        as spec_mod
+    from distributed_resnet_tensorflow_tpu.analysis.rules import (
+        protocol_drift)
+    anchor = os.path.join(PKG, "serve", "fleet.py")
+    monkeypatch.setattr(spec_mod, "_REGISTRY",
+                        {"drifted": _drifted_spec(anchor)})
+    monkeypatch.setattr(spec_mod, "_SPEC_MODULES", ())
+    findings = list(protocol_drift.check(build_context()))
+    msgs = "\n".join(f.message for f in findings)
+    assert all((f.path, f.line) == (anchor, 7) for f in findings)
+    assert "does not exist in the tree" in msgs          # orphaned module
+    assert "appears in none of the modeled sources" in msgs  # dead literal
+    assert "not declared in" in msgs                     # unknown event
+    assert "enum drift" in msgs                          # enum mismatch
+
+
+def test_check_cli_no_protocol_skips_the_rule(tmp_path, monkeypatch):
+    """--no-protocol mirrors --no-hangcheck: the protocol-drift rule is
+    excluded from the lint pass (and the model phase is skipped)."""
+    from distributed_resnet_tensorflow_tpu.analysis.protocol import spec \
+        as spec_mod
+    from distributed_resnet_tensorflow_tpu.main import main
+    pkg = tmp_path / PKG / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "fleetish.py").write_text("PROTOCOL = 'here'\n")
+    anchor = os.path.join(PKG, "serve", "fleetish.py")
+    monkeypatch.setattr(spec_mod, "_REGISTRY",
+                        {"drifted": _drifted_spec(anchor)})
+    monkeypatch.setattr(spec_mod, "_SPEC_MODULES", ())
+    with pytest.raises(SystemExit) as e:
+        main(["check", "--lint-only", "--root", str(tmp_path)])
+    assert e.value.code == 1          # seeded drift fires...
+    with pytest.raises(SystemExit) as e:
+        main(["check", "--lint-only", "--no-protocol",
+              "--root", str(tmp_path)])
+    assert e.value.code == 0          # ...and is opted out cleanly

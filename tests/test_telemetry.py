@@ -397,6 +397,91 @@ def test_monitor_tolerates_torn_and_empty_streams(tmp_path):
     assert agg["streams"]["train"]["step"] == 1
 
 
+def test_monitor_fleet_rollup_spans_rotation_mid_ladder(tmp_path):
+    """A rotation landing in the middle of a replace ladder (kill/respawn
+    in the rotated segment, readmit in the live file, plus a torn tail)
+    must not lose the ladder: the fleet rollup surfaces the newest rung
+    and the joined stream replays protocol-conformant (ISSUE 20)."""
+    from distributed_resnet_tensorflow_tpu.analysis.protocol import (
+        check_stream)
+    from distributed_resnet_tensorflow_tpu.telemetry.monitor import aggregate
+    now = 1000.0
+    d = tmp_path / "route"
+    d.mkdir(parents=True)
+    rotated = [
+        {"event": "route", "time": now - 30, "requests": 500,
+         "completed": 480, "errors": 0, "shed": 0, "qps": 25.0,
+         "p99_ms": 40.0},
+        {"event": "replica_health", "time": now - 21, "replica": 0,
+         "from": "ready", "to": "dead", "reason": "beat_stale"},
+        {"event": "replica_replace", "time": now - 20, "replica": 0,
+         "action": "kill", "reason": "wedged"},
+        {"event": "replica_replace", "time": now - 15, "replica": 0,
+         "action": "respawn"},
+    ]
+    live = [
+        {"event": "replica_replace", "time": now - 5, "replica": 0,
+         "action": "readmit"},
+        {"event": "replica_health", "time": now - 4, "replica": 0,
+         "from": "dead", "to": "warming", "reason": "readmit"},
+        {"event": "route", "time": now - 1, "requests": 600,
+         "completed": 575, "errors": 1, "shed": 0, "qps": 26.0,
+         "p99_ms": 41.0},
+    ]
+    (d / "metrics.jsonl.1").write_text(
+        "".join(json.dumps(r) + "\n" for r in rotated))
+    (d / "metrics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in live)
+        + '{"event": "replica_re')                    # torn mid-write
+    agg = aggregate(str(tmp_path), now=now)
+    fleet = agg["fleet"]
+    assert fleet["requests"] == 600                   # live file leads
+    assert fleet["replica_replace"]["action"] == "readmit"
+    assert fleet["replica_replace"]["replica"] == 0
+    # the ladder that spans the rotation replays as ONE legal round
+    assert check_stream(str(d / "metrics.jsonl")) == []
+
+
+def test_monitor_elastic_rollup_spans_rotation_mid_round(tmp_path):
+    """A reshard round split by rotation (the reshard row in the rotated
+    segment, the new generation's mesh row in the live file): the
+    elastic rollup sees generation + reason, and the step rate bridges
+    the rotation boundary instead of resetting."""
+    from distributed_resnet_tensorflow_tpu.analysis.protocol import (
+        check_stream)
+    from distributed_resnet_tensorflow_tpu.telemetry.monitor import aggregate
+    now = 1000.0
+    d = tmp_path / "train"
+    d.mkdir(parents=True)
+    rotated = [
+        {"step": 80, "time": now - 20, "loss": 2.0},
+        {"step": 90, "time": now - 15, "loss": 1.9},
+        {"event": "reshard", "time": now - 12, "generation": 2,
+         "reason": "peer_lost", "old_hosts": 2, "new_hosts": 1,
+         "restore_step": 90},
+    ]
+    live = [
+        {"event": "mesh_generation", "time": now - 8, "generation": 2,
+         "hosts": 1, "devices": 8, "step": 90},
+        {"step": 110, "time": now - 5, "loss": 1.8},
+        {"step": 120, "time": now, "loss": 1.7},
+    ]
+    (d / "metrics.jsonl.1").write_text(
+        "".join(json.dumps(r) + "\n" for r in rotated))
+    (d / "metrics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in live)
+        + '{"step": 121, "ti')                        # torn mid-write
+    agg = aggregate(str(tmp_path), now=now)
+    assert agg["mesh_generation"] == 2
+    assert agg["last_reshard"]["reason"] == "peer_lost"
+    assert agg["last_reshard"]["new_hosts"] == 1
+    s = agg["streams"]["train"]
+    assert s["step"] == 120
+    # (120 - 80) steps over 20 s across the rotation boundary
+    assert s["steps_per_sec"] == pytest.approx(2.0)
+    assert check_stream(str(d / "metrics.jsonl")) == []
+
+
 # ---------------------------------------------------------------------------
 # watchdog anomaly hook
 # ---------------------------------------------------------------------------

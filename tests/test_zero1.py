@@ -121,6 +121,11 @@ def test_zero1_matches_replicated_update(mesh_cfg, opt):
     assert sharded, "zero1=on left every optimizer leaf replicated"
 
 
+@pytest.mark.slow  # re-tiered out of the 870s tier-1 (ISSUE 20, ~11s: two
+# full trainings under zero1+overlap); tier-1 keeps the zero1+overlap path
+# via test_zero1_overlap_matches_plain_path[dp] and the bucketing
+# bit-identity claim via test_bucketed_is_bit_identical_to_unbucketed[dp];
+# the full (unfiltered) suite still runs this composition
 def test_zero1_overlap_bucketing_is_bit_identical(devices):
     """The gather-order-insensitive pinned claim: under comm.overlap,
     re-bucketing BOTH collectives legs (reduce-scatter exchange and the
